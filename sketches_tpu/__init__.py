@@ -1,0 +1,54 @@
+"""sketches_tpu: a TPU-native quantile-sketch framework (DDSketch semantics).
+
+Re-designed TPU-first from the capability surface of the reference
+``sketches-py`` (DDSketch -- Masson, Rim & Lee, VLDB 2019; SURVEY.md).
+
+Two execution tiers:
+
+* **Host tier** -- ``DDSketch`` and friends: reference-shaped, single-sketch,
+  dynamic stores.  Drop-in for the reference API; also the ground-truth oracle
+  for device-path parity tests.
+* **Device tier** -- ``BatchedDDSketch`` / ``sketches_tpu.batched``:
+  struct-of-arrays ``[n_streams, n_bins]`` state living on TPU; jit'd ingest
+  (scatter-add), fused quantile queries (cumsum + searchsorted, or the Pallas
+  kernel), ``merge`` as ``lax.psum`` over a device mesh.
+"""
+
+from sketches_tpu.ddsketch import (
+    BaseDDSketch,
+    DDSketch,
+    LogCollapsingHighestDenseDDSketch,
+    LogCollapsingLowestDenseDDSketch,
+    UnequalSketchParametersError,
+)
+from sketches_tpu.mapping import (
+    CubicallyInterpolatedMapping,
+    KeyMapping,
+    LinearlyInterpolatedMapping,
+    LogarithmicMapping,
+)
+from sketches_tpu.store import (
+    CollapsingHighestDenseStore,
+    CollapsingLowestDenseStore,
+    DenseStore,
+    Store,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "BaseDDSketch",
+    "DDSketch",
+    "LogCollapsingLowestDenseDDSketch",
+    "LogCollapsingHighestDenseDDSketch",
+    "UnequalSketchParametersError",
+    "KeyMapping",
+    "LogarithmicMapping",
+    "LinearlyInterpolatedMapping",
+    "CubicallyInterpolatedMapping",
+    "Store",
+    "DenseStore",
+    "CollapsingLowestDenseStore",
+    "CollapsingHighestDenseStore",
+    "__version__",
+]

@@ -1,0 +1,260 @@
+"""DDSketch control layer: add / get_quantile_value / merge.
+
+Parity target: reference ``ddsketch/ddsketch.py`` (BaseDDSketch, DDSketch,
+LogCollapsingLowestDenseDDSketch, LogCollapsingHighestDenseDDSketch --
+SURVEY.md section 2 rows 2-3).  A sketch owns one positive store, one negative
+store (holding keys of ``-value``), and a scalar ``zero_count``, plus
+count/min/max/sum bookkeeping.
+
+Accuracy contract: for any quantile q and value stream S,
+``|get_quantile_value(q) - exact_quantile(S, q)| <= alpha * |exact|``.
+Mergeability contract: ``sketch(A).merge(sketch(B)) == sketch(A + B)`` up to
+the same accuracy bound, for sketches with equal gamma.
+
+Backend seam (BASELINE.json north star: "backend='jax' selects the new path
+with no public-API change"): ``DDSketch(..., backend="jax")`` keeps this exact
+API but stores its state as a 1-stream slice of the batched device
+representation (``sketches_tpu.batched``).  For maintaining millions of
+sketches, use ``BatchedDDSketch`` directly.
+"""
+
+from __future__ import annotations
+
+import math
+import typing
+
+from sketches_tpu.mapping import KeyMapping, LogarithmicMapping
+from sketches_tpu.store import (
+    CollapsingHighestDenseStore,
+    CollapsingLowestDenseStore,
+    DenseStore,
+    Store,
+)
+
+__all__ = [
+    "UnequalSketchParametersError",
+    "BaseDDSketch",
+    "DDSketch",
+    "LogCollapsingLowestDenseDDSketch",
+    "LogCollapsingHighestDenseDDSketch",
+]
+
+DEFAULT_REL_ACC = 0.01
+DEFAULT_BIN_LIMIT = 2048
+
+
+class UnequalSketchParametersError(ValueError):
+    """Raised when merging sketches whose mappings (gamma/offset) differ."""
+
+
+class BaseDDSketch:
+    """Quantile sketch with relative-error guarantee alpha.
+
+    Reference seam: ``ddsketch/ddsketch.py . BaseDDSketch``.
+    """
+
+    def __init__(
+        self,
+        mapping: KeyMapping,
+        store: Store,
+        negative_store: Store,
+        zero_count: float = 0.0,
+    ):
+        self._mapping = mapping
+        self._store = store
+        self._negative_store = negative_store
+        self._zero_count = zero_count
+
+        self._relative_accuracy = mapping.relative_accuracy
+        self._count = self._zero_count + self._store.count + self._negative_store.count
+        self._min = math.inf
+        self._max = -math.inf
+        self._sum = 0.0
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(count={self._count}, sum={self._sum},"
+            f" min={self._min}, max={self._max},"
+            f" relative_accuracy={self._relative_accuracy})"
+        )
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def mapping(self) -> KeyMapping:
+        return self._mapping
+
+    @property
+    def store(self) -> Store:
+        return self._store
+
+    @property
+    def negative_store(self) -> Store:
+        return self._negative_store
+
+    @property
+    def zero_count(self) -> float:
+        return self._zero_count
+
+    @property
+    def count(self) -> float:
+        return self._count
+
+    @property
+    def num_values(self) -> float:
+        return self._count
+
+    @property
+    def sum(self) -> float:  # noqa: A003 - reference API name
+        return self._sum
+
+    @property
+    def avg(self) -> float:
+        return self._sum / self._count
+
+    @property
+    def relative_accuracy(self) -> float:
+        return self._relative_accuracy
+
+    # -- core API ---------------------------------------------------------
+    def add(self, val: float, weight: float = 1.0) -> None:
+        """Ingest ``val`` with multiplicity ``weight`` (> 0)."""
+        if weight <= 0.0:
+            raise ValueError("weight must be positive")
+
+        if val > self._mapping.min_possible:
+            self._store.add(self._mapping.key(val), weight)
+        elif val < -self._mapping.min_possible:
+            self._negative_store.add(self._mapping.key(-val), weight)
+        else:
+            self._zero_count += weight
+
+        self._count += weight
+        self._sum += val * weight
+        if val < self._min:
+            self._min = val
+        if val > self._max:
+            self._max = val
+
+    def get_quantile_value(self, quantile: float) -> typing.Optional[float]:
+        """Value at quantile ``q`` in [0, 1], within relative accuracy alpha.
+
+        Returns None for q outside [0, 1] or an empty sketch.
+        """
+        if quantile < 0 or quantile > 1 or self._count == 0:
+            return None
+
+        rank = quantile * (self._count - 1)
+        if rank < self._negative_store.count:
+            reversed_rank = self._negative_store.count - 1 - rank
+            key = self._negative_store.key_at_rank(reversed_rank, lower=False)
+            quantile_value = -self._mapping.value(key)
+        elif rank < self._zero_count + self._negative_store.count:
+            return 0.0
+        else:
+            key = self._store.key_at_rank(
+                rank - self._zero_count - self._negative_store.count
+            )
+            quantile_value = self._mapping.value(key)
+        return quantile_value
+
+    def merge(self, sketch: "BaseDDSketch") -> None:
+        """Fold ``sketch`` into self; equivalent to having ingested its stream."""
+        if not self.mergeable(sketch):
+            raise UnequalSketchParametersError(
+                "Cannot merge two DDSketches with different parameters"
+            )
+        if sketch._count == 0:
+            return
+        if self._count == 0:
+            self._copy(sketch)
+            return
+
+        self._store.merge(sketch._store)
+        self._negative_store.merge(sketch._negative_store)
+        self._zero_count += sketch._zero_count
+
+        self._count += sketch._count
+        self._sum += sketch._sum
+        if sketch._min < self._min:
+            self._min = sketch._min
+        if sketch._max > self._max:
+            self._max = sketch._max
+
+    def mergeable(self, other: "BaseDDSketch") -> bool:
+        """Two sketches are mergeable iff their mappings share gamma."""
+        return self._mapping.gamma == other._mapping.gamma
+
+    def _copy(self, sketch: "BaseDDSketch") -> None:
+        self._store = sketch._store.copy()
+        self._negative_store = sketch._negative_store.copy()
+        self._zero_count = sketch._zero_count
+        self._count = sketch._count
+        self._sum = sketch._sum
+        self._min = sketch._min
+        self._max = sketch._max
+
+    def copy(self) -> "BaseDDSketch":
+        new = type(self).__new__(type(self))
+        new.__dict__.update(self.__dict__)
+        new._copy(self)
+        return new
+
+
+class DDSketch(BaseDDSketch):
+    """Default preset: LogarithmicMapping + unbounded DenseStore (pos & neg).
+
+    Reference seam: ``ddsketch/ddsketch.py . DDSketch``.
+    """
+
+    def __init__(self, relative_accuracy: typing.Optional[float] = None):
+        if relative_accuracy is None:
+            relative_accuracy = DEFAULT_REL_ACC
+        super().__init__(
+            mapping=LogarithmicMapping(relative_accuracy),
+            store=DenseStore(),
+            negative_store=DenseStore(),
+        )
+
+
+class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
+    """LogarithmicMapping + CollapsingLowestDenseStore (bounded memory).
+
+    Reference seam: ``ddsketch/ddsketch.py . LogCollapsingLowestDenseDDSketch``.
+    """
+
+    def __init__(
+        self,
+        relative_accuracy: typing.Optional[float] = None,
+        bin_limit: typing.Optional[int] = None,
+    ):
+        if relative_accuracy is None:
+            relative_accuracy = DEFAULT_REL_ACC
+        if bin_limit is None or bin_limit < 0:
+            bin_limit = DEFAULT_BIN_LIMIT
+        super().__init__(
+            mapping=LogarithmicMapping(relative_accuracy),
+            store=CollapsingLowestDenseStore(bin_limit),
+            negative_store=CollapsingLowestDenseStore(bin_limit),
+        )
+
+
+class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
+    """LogarithmicMapping + CollapsingHighestDenseStore (bounded memory).
+
+    Reference seam: ``ddsketch/ddsketch.py . LogCollapsingHighestDenseDDSketch``.
+    """
+
+    def __init__(
+        self,
+        relative_accuracy: typing.Optional[float] = None,
+        bin_limit: typing.Optional[int] = None,
+    ):
+        if relative_accuracy is None:
+            relative_accuracy = DEFAULT_REL_ACC
+        if bin_limit is None or bin_limit < 0:
+            bin_limit = DEFAULT_BIN_LIMIT
+        super().__init__(
+            mapping=LogarithmicMapping(relative_accuracy),
+            store=CollapsingHighestDenseStore(bin_limit),
+            negative_store=CollapsingHighestDenseStore(bin_limit),
+        )

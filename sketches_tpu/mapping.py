@@ -1,0 +1,283 @@
+"""Key mappings: value <-> bucket-index contracts for DDSketch.
+
+A ``KeyMapping`` assigns every positive float ``v`` an integer key ``k`` such
+that all values in bucket ``k`` are within relative accuracy ``alpha`` of the
+bucket's representative ``value(k)``.  The contract (tested pointwise in
+``tests/test_mapping.py``) is::
+
+    |value(key(v)) - v| <= alpha * v        for all representable v
+
+Parity target: reference ``ddsketch/mapping.py`` (KeyMapping,
+LogarithmicMapping, LinearlyInterpolatedMapping, CubicallyInterpolatedMapping
+-- see SURVEY.md section 2, rows 4a-4d; the reference mount was empty so
+symbol-level citations follow the canonical upstream layout).
+
+TPU-first design notes
+----------------------
+Each mapping exposes *two* computation paths sharing one set of constants:
+
+* scalar path (``key`` / ``value``) -- pure ``math``, used by the host/oracle
+  backend and by tests as ground truth;
+* array path (``key_array`` / ``value_array``) -- pure ``jax.numpy``
+  elementwise kernels, jit/vmap/shard_map-safe (no Python branching on data),
+  used by the batched device backend and inside Pallas kernels.
+
+The cubic mapping's inverse requires solving a monotone cubic on [0, 1).  The
+reference uses Cardano's closed form; here we use a fixed-count Newton
+iteration instead: the cubic's derivative is bounded in [26/35, 10/7] on the
+interval, so Newton from ``s0 = rem`` converges to double precision in <= 5
+steps.  A fixed iteration count is branch-free, vectorizes identically on the
+scalar and array paths, and avoids cube roots / trig that lower poorly to the
+VPU.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Any
+
+import jax.numpy as jnp
+
+__all__ = [
+    "KeyMapping",
+    "LogarithmicMapping",
+    "LinearlyInterpolatedMapping",
+    "CubicallyInterpolatedMapping",
+    "mapping_from_name",
+]
+
+_NEWTON_ITERS = 5
+
+
+class KeyMapping:
+    """Abstract value<->key contract.
+
+    gamma = (1 + alpha) / (1 - alpha); bucket k covers (gamma^(k-1), gamma^k]
+    (modulo the subclass's log approximation), and ``value(k)`` returns the
+    point whose relative distance to both endpoints is exactly alpha.
+    """
+
+    def __init__(self, relative_accuracy: float, offset: float = 0.0):
+        if relative_accuracy <= 0 or relative_accuracy >= 1:
+            raise ValueError("Relative accuracy must be between 0 and 1.")
+        self.relative_accuracy = float(relative_accuracy)
+        self._offset = float(offset)
+
+        gamma_mantissa = 2.0 * relative_accuracy / (1.0 - relative_accuracy)
+        self.gamma = 1.0 + gamma_mantissa
+        # 1 / ln(gamma), computed stably for tiny alpha.
+        self._multiplier = 1.0 / math.log1p(gamma_mantissa)
+        self.min_possible = sys.float_info.min * self.gamma
+        self.max_possible = sys.float_info.max / self.gamma
+
+    # -- subclass hooks: approximate log_gamma and its exact inverse ------
+    def _log_gamma(self, value: float) -> float:
+        raise NotImplementedError
+
+    def _pow_gamma(self, value: float) -> float:
+        raise NotImplementedError
+
+    def _log_gamma_array(self, value: Any) -> Any:
+        raise NotImplementedError
+
+    def _pow_gamma_array(self, value: Any) -> Any:
+        raise NotImplementedError
+
+    # -- scalar path ------------------------------------------------------
+    def key(self, value: float) -> int:
+        """Integer bucket key for ``value`` (value > 0)."""
+        return int(math.ceil(self._log_gamma(value)) + self._offset)
+
+    def value(self, key: int) -> float:
+        """Representative value of bucket ``key`` (within alpha of all members)."""
+        return self._pow_gamma(key - self._offset) * (2.0 / (1.0 + self.gamma))
+
+    # -- array path (jnp; jit/vmap-safe) ----------------------------------
+    def key_array(self, value):
+        """Elementwise ``key`` for an array of positive values -> int32 keys."""
+        return jnp.ceil(self._log_gamma_array(value)).astype(jnp.int32) + jnp.int32(
+            round(self._offset)
+        )
+
+    def value_array(self, key):
+        """Elementwise ``value`` for an int array of keys -> float values."""
+        k = key.astype(jnp.float32) - jnp.float32(self._offset)
+        return self._pow_gamma_array(k) * jnp.float32(2.0 / (1.0 + self.gamma))
+
+    # -- equality / identity ----------------------------------------------
+    def __eq__(self, other: object) -> bool:
+        return (
+            type(self) is type(other)
+            and self.gamma == other.gamma  # type: ignore[attr-defined]
+            and self._offset == other._offset  # type: ignore[attr-defined]
+        )
+
+    def __hash__(self) -> int:
+        return hash((type(self).__name__, self.gamma, self._offset))
+
+    def __repr__(self) -> str:
+        return (
+            f"{type(self).__name__}(relative_accuracy={self.relative_accuracy},"
+            f" offset={self._offset})"
+        )
+
+
+class LogarithmicMapping(KeyMapping):
+    """Exact ``ln(v) / ln(gamma)`` mapping -- memory-optimal, one log per key."""
+
+    def __init__(self, relative_accuracy: float, offset: float = 0.0):
+        super().__init__(relative_accuracy, offset=offset)
+
+    def _log_gamma(self, value: float) -> float:
+        return math.log(value) * self._multiplier
+
+    def _pow_gamma(self, value: float) -> float:
+        return math.exp(value / self._multiplier)
+
+    def _log_gamma_array(self, value):
+        return jnp.log(value) * jnp.float32(self._multiplier)
+
+    def _pow_gamma_array(self, value):
+        return jnp.exp(value / jnp.float32(self._multiplier))
+
+
+def _frexp_array(value):
+    """(mantissa in [0.5, 1), integer exponent) such that v = m * 2**e.
+
+    jnp.frexp exists but we inline via exponent extraction so the same
+    expression lowers cleanly inside Pallas kernels.
+    """
+    m, e = jnp.frexp(value)
+    return m, e.astype(jnp.float32)
+
+
+class LinearlyInterpolatedMapping(KeyMapping):
+    """Approximates log2 linearly between powers of two (no transcendentals).
+
+    log2(v) ~= (exponent - 1) + (2*mantissa - 1) for v = mantissa * 2**exponent,
+    mantissa in [0.5, 1).  The approximation's derivative w.r.t. log2(v) is
+    2 * mantissa * ln2, minimized at mantissa = 0.5 where it equals
+    ln(2) ~= 0.693.  Keeping the base multiplier 1/ln(gamma) *unscaled* (note:
+    NOT 1/log2(gamma)) therefore guarantees buckets no wider than gamma --
+    verified by brute-force worst-case sweep; the ln2-scaled variant violates
+    alpha near octave bottoms.  Cost: 1/ln2 ~= 1.44x the buckets of the exact
+    log, in exchange for replacing the transcendental log with exponent
+    bit-twiddling.
+    """
+
+    def _log2_approx(self, value: float) -> float:
+        mantissa, exponent = math.frexp(value)
+        significand = 2.0 * mantissa - 1.0
+        return significand + (exponent - 1)
+
+    def _exp2_approx(self, value: float) -> float:
+        exponent = math.floor(value)
+        mantissa = (value - exponent + 1.0) / 2.0
+        return math.ldexp(mantissa, exponent + 1)
+
+    def _log_gamma(self, value: float) -> float:
+        return self._log2_approx(value) * self._multiplier
+
+    def _pow_gamma(self, value: float) -> float:
+        return self._exp2_approx(value / self._multiplier)
+
+    def _log_gamma_array(self, value):
+        m, e = _frexp_array(value)
+        return (2.0 * m - 1.0 + (e - 1.0)) * jnp.float32(self._multiplier)
+
+    def _pow_gamma_array(self, value):
+        v = value / jnp.float32(self._multiplier)
+        exponent = jnp.floor(v)
+        mantissa = (v - exponent + 1.0) / 2.0
+        return jnp.ldexp(mantissa, exponent.astype(jnp.int32) + 1)
+
+
+class CubicallyInterpolatedMapping(KeyMapping):
+    """Cubic interpolation of log2 on the mantissa: ~1% memory overhead,
+    no transcendentals on the key path.
+
+    With s = 2*mantissa - 1 in [0, 1):
+
+        f(s) = ((A*s + B)*s + C)*s,   A = 6/35, B = -3/5, C = 10/7
+
+    f(0) = 0 and f(1) = 1, so ``f(s) + (exponent - 1)`` is continuous across
+    octaves and approximates log2(v).  Its derivative w.r.t. log2(v) is
+    f'(s) * 2m * ln2, minimized at m = 1/2 (s = 0) where it equals
+    (10/7) * ln2.  Guaranteeing buckets no wider than gamma therefore needs
+    multiplier c = 1 / ((10/7) * ln2 * log2(gamma)) = (7/10) / ln(gamma) --
+    i.e. 0.7/ln2 ~= 1.0100x the bucket count of the exact log (the ~1%
+    overhead), at far lower per-value cost.
+
+    The inverse solves the monotone cubic with a fixed 5-step Newton iteration
+    (see module docstring) rather than Cardano's formula.
+    """
+
+    A = 6.0 / 35.0
+    B = -3.0 / 5.0
+    C = 10.0 / 7.0
+
+    def __init__(self, relative_accuracy: float, offset: float = 0.0):
+        super().__init__(relative_accuracy, offset=offset)
+        self._multiplier *= 7.0 / 10.0
+
+    # f and f' on the significand
+    @classmethod
+    def _cubic(cls, s):
+        return ((cls.A * s + cls.B) * s + cls.C) * s
+
+    @classmethod
+    def _cubic_deriv(cls, s):
+        return (3.0 * cls.A * s + 2.0 * cls.B) * s + cls.C
+
+    def _cubic_log2(self, value: float) -> float:
+        mantissa, exponent = math.frexp(value)
+        return self._cubic(2.0 * mantissa - 1.0) + (exponent - 1)
+
+    def _cubic_exp2(self, value: float) -> float:
+        exponent = math.floor(value)
+        rem = value - exponent
+        s = rem  # f(s) ~= s to first order; Newton polishes
+        for _ in range(_NEWTON_ITERS):
+            s = s - (self._cubic(s) - rem) / self._cubic_deriv(s)
+        mantissa = (s + 1.0) / 2.0
+        return math.ldexp(mantissa, exponent + 1)
+
+    def _log_gamma(self, value: float) -> float:
+        return self._cubic_log2(value) * self._multiplier
+
+    def _pow_gamma(self, value: float) -> float:
+        return self._cubic_exp2(value / self._multiplier)
+
+    def _log_gamma_array(self, value):
+        m, e = _frexp_array(value)
+        s = 2.0 * m - 1.0
+        return (self._cubic(s) + (e - 1.0)) * jnp.float32(self._multiplier)
+
+    def _pow_gamma_array(self, value):
+        v = value / jnp.float32(self._multiplier)
+        exponent = jnp.floor(v)
+        rem = v - exponent
+        s = rem
+        for _ in range(_NEWTON_ITERS):
+            s = s - (self._cubic(s) - rem) / self._cubic_deriv(s)
+        mantissa = (s + 1.0) / 2.0
+        return jnp.ldexp(mantissa, exponent.astype(jnp.int32) + 1)
+
+
+_MAPPING_REGISTRY = {
+    "logarithmic": LogarithmicMapping,
+    "linear_interpolated": LinearlyInterpolatedMapping,
+    "cubic_interpolated": CubicallyInterpolatedMapping,
+}
+
+
+def mapping_from_name(name: str, relative_accuracy: float, offset: float = 0.0) -> KeyMapping:
+    """Instantiate a mapping by registry name (config-file / proto seam)."""
+    try:
+        cls = _MAPPING_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown mapping {name!r}; expected one of {sorted(_MAPPING_REGISTRY)}"
+        ) from None
+    return cls(relative_accuracy, offset=offset)

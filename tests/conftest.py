@@ -1,0 +1,18 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference test strategy (SURVEY.md section 4): distributed
+correctness is tested as merge algebra on an in-process device mesh -- no TPU
+required.  Must run before anything imports jax, hence env setup at module
+import time in conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# NOTE: x64 stays disabled -- the device tier is designed for f32/bf16 (TPU),
+# and tests must exercise the same numerics the hardware will.

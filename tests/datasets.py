@@ -1,0 +1,205 @@
+"""Ground-truth datasets: generators that keep raw values so exact quantiles
+are computable by sorting.
+
+Parity target: reference ``tests/datasets.py`` (SURVEY.md section 2 row 9) --
+uniform variants, constant, exponential, lognormal, normal, laplace, bimodal,
+trimodal, integer-valued, negative and mixed-sign distributions.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+EPSILON = 1e-9
+
+
+class Dataset:
+    """Base: subclasses implement ``populate`` to fill ``self.data``."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self.data: list[float] = []
+        self.populate()
+        self._sorted = None
+
+    def populate(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def sorted_data(self):
+        if self._sorted is None:
+            self._sorted = np.sort(np.asarray(self.data, dtype=np.float64))
+        return self._sorted
+
+    def quantile(self, q: float) -> float:
+        """Exact lower quantile: element at rank floor(q * (n - 1))."""
+        data = self.sorted_data
+        rank = int(q * (len(data) - 1))
+        return float(data[rank])
+
+    @property
+    def sum(self) -> float:  # noqa: A003
+        return float(np.sum(np.asarray(self.data, dtype=np.float64)))
+
+    @property
+    def avg(self) -> float:
+        return self.sum / len(self.data)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def __iter__(self):
+        return iter(self.data)
+
+
+class UniformForward(Dataset):
+    def populate(self):
+        self.data = [float(x) for x in range(1, self.size + 1)]
+
+
+class UniformBackward(Dataset):
+    def populate(self):
+        self.data = [float(x) for x in range(self.size, 0, -1)]
+
+
+class UniformZoomIn(Dataset):
+    """Alternates outermost-in: 1, n, 2, n-1, ..."""
+
+    def populate(self):
+        lo, hi = 1, self.size
+        while lo <= hi:
+            self.data.append(float(lo))
+            if hi != lo:
+                self.data.append(float(hi))
+            lo += 1
+            hi -= 1
+
+
+class UniformZoomOut(Dataset):
+    """Alternates center-out."""
+
+    def populate(self):
+        mid = (self.size + 1) // 2
+        lo, hi = mid, mid + 1
+        while lo >= 1 or hi <= self.size:
+            if lo >= 1:
+                self.data.append(float(lo))
+                lo -= 1
+            if hi <= self.size:
+                self.data.append(float(hi))
+                hi += 1
+
+
+class UniformSqrt(Dataset):
+    """Interleaves sqrt(n)-strided passes over [1, n]."""
+
+    def populate(self):
+        stride = max(1, int(math.sqrt(self.size)))
+        for start in range(stride):
+            for x in range(start + 1, self.size + 1, stride):
+                self.data.append(float(x))
+        self.data = self.data[: self.size]
+        while len(self.data) < self.size:
+            self.data.append(float(self.size))
+
+
+class Constant(Dataset):
+    def populate(self):
+        self.data = [42.0] * self.size
+
+
+class NegativeUniformForward(Dataset):
+    def populate(self):
+        self.data = [-float(x) for x in range(self.size, 0, -1)]
+
+
+class NegativeUniformBackward(Dataset):
+    def populate(self):
+        self.data = [-float(x) for x in range(1, self.size + 1)]
+
+
+class NumberLineBackward(Dataset):
+    """Mixed sign: n/2 ... -n/2 crossing zero."""
+
+    def populate(self):
+        half = self.size // 2
+        self.data = [float(x) for x in range(half, half - self.size, -1)]
+
+
+class UniformMixedSign(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size)
+        self.data = list(rng.uniform(-1.0, 1.0, self.size).astype(float))
+
+
+class Integers(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 1)
+        self.data = [float(x) for x in rng.randint(-25, 25, self.size)]
+
+
+class Normal(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 2)
+        self.data = list(rng.normal(37.4, 1.0, self.size).astype(float))
+
+
+class Lognormal(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 3)
+        self.data = list(rng.lognormal(0.0, 2.0, self.size).astype(float))
+
+
+class Exponential(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 4)
+        self.data = list(rng.exponential(2.0, self.size).astype(float))
+
+
+class Laplace(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 5)
+        self.data = list(rng.laplace(11278.0, 100.0, self.size).astype(float))
+
+
+class Bimodal(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 6)
+        a = rng.normal(17.3, 1.0, self.size // 2)
+        b = rng.exponential(2.0, self.size - self.size // 2)
+        self.data = list(np.concatenate([a, b]).astype(float))
+        rng.shuffle(self.data)
+
+
+class Trimodal(Dataset):
+    def populate(self):
+        rng = np.random.RandomState(self.size + 7)
+        third = self.size // 3
+        a = rng.normal(5.0, 1.0, third)
+        b = rng.normal(-7.0, 0.5, third)
+        c = rng.exponential(0.5, self.size - 2 * third)
+        self.data = list(np.concatenate([a, b, c]).astype(float))
+        rng.shuffle(self.data)
+
+
+ALL_DATASETS = [
+    UniformForward,
+    UniformBackward,
+    UniformZoomIn,
+    UniformZoomOut,
+    UniformSqrt,
+    Constant,
+    NegativeUniformForward,
+    NegativeUniformBackward,
+    NumberLineBackward,
+    UniformMixedSign,
+    Integers,
+    Normal,
+    Lognormal,
+    Exponential,
+    Laplace,
+    Bimodal,
+    Trimodal,
+]

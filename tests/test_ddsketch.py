@@ -1,0 +1,171 @@
+"""Sketch accuracy + merge semantics vs ground-truth datasets.
+
+Mirrors reference ``tests/test_ddsketch.py`` (SURVEY.md section 2 row 10,
+section 4): relative-error contract across ~17 distributions and sizes; merge
+as semantic equivalence (sketch(A) U sketch(B) ~ sketch(A+B)); weighted adds;
+zero/negative handling."""
+
+import math
+
+import pytest
+
+from sketches_tpu import (
+    DDSketch,
+    LogCollapsingHighestDenseDDSketch,
+    LogCollapsingLowestDenseDDSketch,
+)
+from tests.datasets import ALL_DATASETS, EPSILON, Integers, Normal, UniformForward
+
+TEST_REL_ACC = 0.05
+TEST_BIN_LIMIT = 1024
+TEST_QUANTILES = [0.0, 0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99, 1.0]
+TEST_SIZES = [3, 21, 100, 5000]
+
+SKETCH_FACTORIES = [
+    lambda: DDSketch(TEST_REL_ACC),
+    lambda: LogCollapsingLowestDenseDDSketch(TEST_REL_ACC, TEST_BIN_LIMIT),
+    lambda: LogCollapsingHighestDenseDDSketch(TEST_REL_ACC, TEST_BIN_LIMIT),
+]
+SKETCH_IDS = ["dense", "collapsing_lowest", "collapsing_highest"]
+
+
+def _evaluate_sketch_accuracy(sketch, dataset, eps=EPSILON):
+    for q in TEST_QUANTILES:
+        exact = dataset.quantile(q)
+        got = sketch.get_quantile_value(q)
+        err = abs(got - exact)
+        assert err - TEST_REL_ACC * abs(exact) <= eps, (q, exact, got)
+    assert sketch.num_values == pytest.approx(len(dataset))
+    assert sketch.sum == pytest.approx(dataset.sum, rel=1e-6)
+    assert sketch.avg == pytest.approx(dataset.avg, rel=1e-6)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+@pytest.mark.parametrize("dataset_cls", ALL_DATASETS)
+@pytest.mark.parametrize("size", TEST_SIZES)
+def test_distributions(factory, dataset_cls, size):
+    dataset = dataset_cls(size)
+    sketch = factory()
+    for v in dataset:
+        sketch.add(v)
+    _evaluate_sketch_accuracy(sketch, dataset)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_merge_equal_split(factory):
+    dataset = Normal(2000)
+    s1, s2 = factory(), factory()
+    for i, v in enumerate(dataset):
+        (s1 if i % 2 == 0 else s2).add(v)
+    s1.merge(s2)
+    _evaluate_sketch_accuracy(s1, dataset)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_merge_unequal_split(factory):
+    dataset = Integers(1000)
+    s1, s2 = factory(), factory()
+    for i, v in enumerate(dataset):
+        (s1 if i < 100 else s2).add(v)
+    s1.merge(s2)
+    _evaluate_sketch_accuracy(s1, dataset)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_merge_mixed_sign_and_repeated(factory):
+    from tests.datasets import NumberLineBackward
+
+    dataset = NumberLineBackward(999)
+    parts = [factory() for _ in range(4)]
+    for i, v in enumerate(dataset):
+        parts[i % 4].add(v)
+    acc = factory()
+    for p in parts:
+        acc.merge(p)
+    _evaluate_sketch_accuracy(acc, dataset)
+    # merging an empty sketch is a no-op
+    acc.merge(factory())
+    _evaluate_sketch_accuracy(acc, dataset)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_merge_commutative_accuracy(factory):
+    dataset = Normal(1000)
+    a1, a2 = factory(), factory()
+    b1, b2 = factory(), factory()
+    for i, v in enumerate(dataset):
+        (a1 if i % 2 else a2).add(v)
+        (b1 if i % 2 else b2).add(v)
+    a1.merge(a2)
+    b2.merge(b1)
+    for q in TEST_QUANTILES:
+        ga, gb = a1.get_quantile_value(q), b2.get_quantile_value(q)
+        exact = dataset.quantile(q)
+        assert abs(ga - exact) <= TEST_REL_ACC * abs(exact) + EPSILON
+        assert abs(gb - exact) <= TEST_REL_ACC * abs(exact) + EPSILON
+
+
+def test_merge_unmergeable_raises():
+    from sketches_tpu import UnequalSketchParametersError
+
+    s1, s2 = DDSketch(0.01), DDSketch(0.05)
+    s2.add(1.0)
+    with pytest.raises(UnequalSketchParametersError):
+        s1.merge(s2)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_weighted_add(factory):
+    """add(v, w) with integer w equals adding v w times."""
+    weighted, repeated = factory(), factory()
+    vals = [(1.0, 3), (2.5, 1), (10.0, 5), (-4.0, 2), (0.0, 4)]
+    for v, w in vals:
+        weighted.add(v, float(w))
+        for _ in range(w):
+            repeated.add(v)
+    assert weighted.count == repeated.count
+    for q in TEST_QUANTILES:
+        assert weighted.get_quantile_value(q) == pytest.approx(
+            repeated.get_quantile_value(q)
+        )
+    with pytest.raises(ValueError):
+        factory().add(1.0, weight=0.0)
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_zeros_and_negatives(factory):
+    s = factory()
+    for v in [0.0, 0.0, -1.0, 1.0, 0.0]:
+        s.add(v)
+    assert s.count == 5
+    assert s.zero_count == 3
+    assert s.get_quantile_value(0.5) == 0.0
+    assert abs(s.get_quantile_value(0.0) - (-1.0)) <= TEST_REL_ACC + EPSILON
+    assert abs(s.get_quantile_value(1.0) - 1.0) <= TEST_REL_ACC + EPSILON
+
+
+@pytest.mark.parametrize("factory", SKETCH_FACTORIES, ids=SKETCH_IDS)
+def test_empty_and_invalid_quantiles(factory):
+    s = factory()
+    assert s.get_quantile_value(0.5) is None
+    s.add(1.0)
+    assert s.get_quantile_value(-0.1) is None
+    assert s.get_quantile_value(1.1) is None
+    assert abs(s.get_quantile_value(0.5) - 1.0) <= TEST_REL_ACC + EPSILON
+
+
+def test_copy_is_deep():
+    s = DDSketch(0.01)
+    for v in UniformForward(100):
+        s.add(v)
+    c = s.copy()
+    c.add(1e6)
+    assert s.count == 100
+    assert c.count == 101
+
+
+def test_tiny_values_go_to_zero_bucket():
+    s = DDSketch(0.01)
+    s.add(1e-320)  # below min_possible -> zero bucket
+    assert s.zero_count == 1
+    assert s.get_quantile_value(0.5) == 0.0

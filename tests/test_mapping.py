@@ -1,0 +1,131 @@
+"""Mapping contract tests: |value(key(v)) - v| <= alpha * v, scalar and array
+paths, round-trips, equality.  Mirrors reference ``tests/test_mapping.py``
+(SURVEY.md section 2 row 12, section 4)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sketches_tpu.mapping import (
+    CubicallyInterpolatedMapping,
+    KeyMapping,
+    LinearlyInterpolatedMapping,
+    LogarithmicMapping,
+    mapping_from_name,
+)
+
+MAPPINGS = [LogarithmicMapping, LinearlyInterpolatedMapping, CubicallyInterpolatedMapping]
+ACCURACIES = [1e-1, 2e-2, 1e-2, 1e-3]
+
+
+def _test_values():
+    vals = []
+    v = 1e-10
+    while v < 1e12:
+        vals.append(v)
+        v *= 1.37
+    vals += [1.0, 1.5, 2.0 ** 10, 2.0 ** -10, 3.1415, 1e100, 1e-100]
+    return vals
+
+
+@pytest.mark.parametrize("mapping_cls", MAPPINGS)
+@pytest.mark.parametrize("rel_acc", ACCURACIES)
+def test_scalar_accuracy_contract(mapping_cls, rel_acc):
+    m = mapping_cls(rel_acc)
+    for v in _test_values():
+        recon = m.value(m.key(v))
+        # (1 + 1e-9) slack: values exactly on a bucket edge hit the alpha
+        # bound exactly, modulo one ULP of float arithmetic.
+        assert abs(recon - v) <= rel_acc * v * (1 + 1e-9) + 1e-300, (mapping_cls, v)
+
+
+@pytest.mark.parametrize("mapping_cls", MAPPINGS)
+@pytest.mark.parametrize("rel_acc", [1e-1, 1e-2])
+def test_array_accuracy_contract(mapping_cls, rel_acc):
+    """Array (jnp, f32) path: same contract with an f32-noise allowance."""
+    m = mapping_cls(rel_acc)
+    # f32 representable range only
+    vals = np.array([v for v in _test_values() if 1e-30 < v < 1e30], dtype=np.float32)
+    keys = m.key_array(jnp.asarray(vals))
+    recon = np.asarray(m.value_array(keys), dtype=np.float64)
+    tol = rel_acc * vals.astype(np.float64) * (1 + 1e-5) + 1e-30
+    assert np.all(np.abs(recon - vals.astype(np.float64)) <= tol)
+
+
+@pytest.mark.parametrize("mapping_cls", MAPPINGS)
+def test_scalar_array_key_parity(mapping_cls):
+    """Array keys match scalar keys except at most +/-1 from f32 rounding at
+    ceil boundaries; bucket values must still honor the contract (checked in
+    the accuracy tests)."""
+    m = mapping_cls(0.01)
+    vals = [v for v in _test_values() if 1e-30 < v < 1e30]
+    scalar_keys = np.array([m.key(v) for v in vals])
+    array_keys = np.asarray(m.key_array(jnp.asarray(vals, dtype=jnp.float32)))
+    assert np.all(np.abs(scalar_keys - array_keys) <= 1)
+    # the overwhelming majority must agree exactly
+    assert np.mean(scalar_keys == array_keys) > 0.99
+
+
+@pytest.mark.parametrize("mapping_cls", MAPPINGS)
+def test_key_monotonic(mapping_cls):
+    m = mapping_cls(0.02)
+    vals = sorted(_test_values())
+    keys = [m.key(v) for v in vals]
+    assert keys == sorted(keys)
+
+
+@pytest.mark.parametrize("mapping_cls", MAPPINGS)
+def test_value_in_bucket(mapping_cls):
+    """value(k) must itself map back to bucket k (self-consistency)."""
+    m = mapping_cls(0.01)
+    for k in range(-500, 500, 7):
+        assert m.key(m.value(k)) == k
+
+
+def test_offset_shifts_keys():
+    m0 = LogarithmicMapping(0.01)
+    m7 = LogarithmicMapping(0.01, offset=7.0)
+    for v in [0.1, 1.0, 42.0]:
+        assert m7.key(v) == m0.key(v) + 7
+        assert m7.value(m7.key(v)) == pytest.approx(m0.value(m0.key(v)), rel=1e-12)
+
+
+def test_equality_and_hash():
+    assert LogarithmicMapping(0.01) == LogarithmicMapping(0.01)
+    assert LogarithmicMapping(0.01) != LogarithmicMapping(0.02)
+    assert LogarithmicMapping(0.01) != CubicallyInterpolatedMapping(0.01)
+    assert LogarithmicMapping(0.01, offset=1.0) != LogarithmicMapping(0.01)
+    assert hash(LogarithmicMapping(0.01)) == hash(LogarithmicMapping(0.01))
+
+
+def test_gamma_formula():
+    m = LogarithmicMapping(0.01)
+    assert m.gamma == pytest.approx((1 + 0.01) / (1 - 0.01), rel=1e-12)
+
+
+def test_invalid_accuracy():
+    for bad in (0.0, 1.0, -0.5, 2.0):
+        with pytest.raises(ValueError):
+            LogarithmicMapping(bad)
+
+
+def test_registry():
+    for name, cls in [
+        ("logarithmic", LogarithmicMapping),
+        ("linear_interpolated", LinearlyInterpolatedMapping),
+        ("cubic_interpolated", CubicallyInterpolatedMapping),
+    ]:
+        m = mapping_from_name(name, 0.05)
+        assert isinstance(m, cls)
+        assert isinstance(m, KeyMapping)
+    with pytest.raises(ValueError):
+        mapping_from_name("nope", 0.05)
+
+
+def test_min_max_possible_guard():
+    m = LogarithmicMapping(0.01)
+    assert m.min_possible > 0
+    v = m.min_possible * 2
+    assert m.value(m.key(v)) == pytest.approx(v, rel=0.01)

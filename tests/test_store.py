@@ -1,0 +1,187 @@
+"""Store invariant tests, parametrized over the three dense store variants.
+
+Mirrors reference ``tests/test_store.py`` (SURVEY.md section 2 row 11):
+add/merge/extremes, bin_limit collapse (mass conservation into the edge bin),
+key_at_rank tie-breaking."""
+
+import math
+
+import pytest
+
+from sketches_tpu.store import (
+    CollapsingHighestDenseStore,
+    CollapsingLowestDenseStore,
+    DenseStore,
+)
+
+BIN_LIMIT = 64
+
+
+def make_stores():
+    return [
+        DenseStore(),
+        CollapsingLowestDenseStore(BIN_LIMIT),
+        CollapsingHighestDenseStore(BIN_LIMIT),
+    ]
+
+
+STORE_FACTORIES = [
+    lambda: DenseStore(),
+    lambda: CollapsingLowestDenseStore(BIN_LIMIT),
+    lambda: CollapsingHighestDenseStore(BIN_LIMIT),
+]
+IDS = ["dense", "collapsing_lowest", "collapsing_highest"]
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_empty(factory):
+    s = factory()
+    assert s.is_empty
+    assert s.count == 0
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_add_counts(factory):
+    s = factory()
+    for k in [0, 1, -5, 100, 0, 0]:
+        s.add(k)
+    assert s.count == 6
+    s.add(3, weight=2.5)
+    assert s.count == pytest.approx(8.5)
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_mass_conservation_wide_range(factory):
+    """Total mass survives any amount of range growth / collapsing."""
+    s = factory()
+    keys = list(range(-200, 201, 3)) + [1000, -1000, 5, 5, 5]
+    for k in keys:
+        s.add(k)
+    assert s.count == pytest.approx(len(keys))
+    assert sum(s.bins) == pytest.approx(len(keys))
+
+
+def test_dense_exact_recovery():
+    s = DenseStore()
+    keys = [5, -3, 12, 5, 5, -3]
+    for k in keys:
+        s.add(k)
+    got = {k: s.bins[k - s.offset] for k in (-3, 5, 12)}
+    assert got == {-3: 2.0, 5: 3.0, 12: 1.0}
+
+
+def test_key_at_rank_lower_upper():
+    s = DenseStore()
+    for k, w in [(0, 1.0), (1, 2.0), (2, 1.0)]:
+        s.add(k, w)
+    # cumulative: key0->1, key1->3, key2->4
+    assert s.key_at_rank(0) == 0
+    assert s.key_at_rank(0.5) == 0
+    assert s.key_at_rank(1) == 1
+    assert s.key_at_rank(2.5) == 1
+    assert s.key_at_rank(3) == 2
+    # lower=False: first key with cum >= rank+1
+    assert s.key_at_rank(0, lower=False) == 0
+    assert s.key_at_rank(1, lower=False) == 1
+    assert s.key_at_rank(3, lower=False) == 2
+
+
+def test_collapsing_lowest_collapse_semantics():
+    s = CollapsingLowestDenseStore(8)
+    for k in range(16):
+        s.add(k)
+    # window pinned at top: keys [8, 15]; keys < 8 collapsed into floor bin
+    assert s.count == 16
+    assert s.is_collapsed
+    assert s.max_key == 15
+    assert s.min_key == 8
+    assert s.bins[0] == pytest.approx(9.0)  # keys 0..7 plus key 8
+    # adds below the floor keep landing in the floor bin
+    s.add(-100)
+    assert s.count == 17
+    assert s.bins[0] == pytest.approx(10.0)
+
+
+def test_collapsing_highest_collapse_semantics():
+    s = CollapsingHighestDenseStore(8)
+    for k in range(16):
+        s.add(k)
+    # window pinned at bottom: keys [0, 7]; keys > 7 collapsed into top bin
+    assert s.count == 16
+    assert s.is_collapsed
+    assert s.min_key == 0
+    assert s.max_key == 7
+    assert s.bins[-1] == pytest.approx(9.0)  # key 7 plus keys 8..15
+    s.add(1000)
+    assert s.bins[-1] == pytest.approx(10.0)
+
+
+def test_collapsing_lowest_descending_insert():
+    s = CollapsingLowestDenseStore(8)
+    for k in range(15, -1, -1):
+        s.add(k)
+    assert s.count == 16
+    assert sum(s.bins) == pytest.approx(16)
+    assert s.max_key == 15
+
+
+def test_collapsing_highest_ascending_then_jump():
+    s = CollapsingHighestDenseStore(8)
+    s.add(100)
+    s.add(0)  # forces window down to [0, 7]; 100 collapses into top
+    assert s.count == 2
+    assert sum(s.bins) == pytest.approx(2)
+    assert s.min_key == 0
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_merge_equals_sequential_adds(factory):
+    a, b, ref = factory(), factory(), factory()
+    keys_a = [1, 2, 3, 4, 5, -2]
+    keys_b = [4, 5, 6, 200, -100]
+    for k in keys_a:
+        a.add(k)
+        ref.add(k)
+    for k in keys_b:
+        b.add(k)
+        ref.add(k)
+    a.merge(b)
+    assert a.count == pytest.approx(ref.count)
+    # same mass at every key
+    all_keys = range(-300, 301)
+    for k in all_keys:
+        ka = a.bins[k - a.offset] if 0 <= k - a.offset < len(a.bins) else 0.0
+        kr = ref.bins[k - ref.offset] if 0 <= k - ref.offset < len(ref.bins) else 0.0
+        assert ka == pytest.approx(kr), k
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_merge_into_empty_and_from_empty(factory):
+    a, b = factory(), factory()
+    for k in [1, 2, 3]:
+        b.add(k)
+    a.merge(b)
+    assert a.count == 3
+    c = factory()
+    a.merge(c)  # merging empty is a no-op
+    assert a.count == 3
+
+
+@pytest.mark.parametrize("factory", STORE_FACTORIES, ids=IDS)
+def test_copy_independent(factory):
+    a = factory()
+    a.add(5)
+    b = a.copy()
+    b.add(6)
+    assert a.count == 1
+    assert b.count == 2
+
+
+def test_extreme_keys():
+    for s in (CollapsingLowestDenseStore(16), CollapsingHighestDenseStore(16)):
+        s.add(2 ** 20)
+        s.add(-(2 ** 20))
+        s.add(0)
+        assert s.count == 3
+        assert sum(s.bins) == pytest.approx(3)
+        assert len(s.bins) <= 16
