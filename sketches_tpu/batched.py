@@ -1,0 +1,604 @@
+"""Device tier: batched DDSketch as struct-of-arrays on TPU.
+
+This is the TPU-native redesign of the reference's object-per-sketch model
+(reference seams: ``ddsketch/ddsketch.py . BaseDDSketch`` +
+``ddsketch/store.py . CollapsingLowestDenseStore`` -- SURVEY.md sections 2, 7).
+One *batch* of ``n_streams`` independent sketches is a single pytree of
+device arrays:
+
+    bins_pos, bins_neg : f32[n_streams, n_bins]
+    zero_count, count, sum, min, max : f32[n_streams]
+
+and every operation is a pure function ``state -> state`` (ingest, merge) or
+``state -> values`` (query), jit/vmap/shard_map-safe:
+
+* **Static shapes.** The reference grows stores dynamically
+  (``DenseStore._extend_range``); XLA wants static shapes, so the device
+  store is *always-collapsing*: keys clamp into the fixed window
+  ``[key_offset, key_offset + n_bins)``.  Clamping at the low edge is exactly
+  ``CollapsingLowestDenseStore`` semantics; clamping at the high edge is
+  ``CollapsingHighestDenseStore`` semantics; both edges are live at once and
+  per-stream collapsed-mass counters surface the (silent, in the reference)
+  resolution loss.  With the default alpha = 0.01 and n_bins = 2048 the
+  window spans ~18 decades -- wider than the reference's default
+  ``bin_limit=2048`` ever reaches before collapsing.
+* **Branch-free three-way split.** The reference branches per value
+  (positive / negative / zero); here masks + ``jnp.where`` route every value
+  through the same arithmetic (SURVEY.md section 7 "hard parts").
+* **Ingest is one scatter-add per store.** ``values -> keys -> clamp ->
+  scatter-add``, vmapped over streams.  XLA scatter-add is deterministic-sum:
+  duplicate keys within one batch accumulate exactly (tested).
+* **Query is cumsum + searchsorted.** The reference's linear
+  ``key_at_rank`` walk becomes one prefix-sum reused across all requested
+  quantiles, vmapped over streams.
+* **Merge is elementwise add.** Offset alignment vanishes with a shared
+  static window, so ``merge`` is ``a + b`` on bins and counters -- and the
+  distributed merge is literally ``lax.psum`` (``sketches_tpu/parallel.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketches_tpu.mapping import KeyMapping, mapping_from_name
+
+__all__ = [
+    "SketchSpec",
+    "SketchState",
+    "init",
+    "add",
+    "quantile",
+    "get_quantile_value",
+    "merge",
+    "merge_axis",
+    "BatchedDDSketch",
+]
+
+DEFAULT_REL_ACC = 0.01
+DEFAULT_N_BINS = 2048
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchSpec:
+    """Static (hashable, trace-time) configuration of a sketch batch.
+
+    Plays the role of the reference's constructor arguments
+    (``relative_accuracy``, ``bin_limit``, mapping choice) plus the one
+    TPU-specific knob the reference cannot have: ``key_offset``, the low edge
+    of the static key window.  Two batches are mergeable iff their specs are
+    equal (the reference's same-gamma check, made total).
+    """
+
+    relative_accuracy: float = DEFAULT_REL_ACC
+    mapping_name: str = "logarithmic"
+    n_bins: int = DEFAULT_N_BINS
+    # Low edge of the representable key window.  The default centers the
+    # window on key(1.0) = 0, covering values in roughly
+    # [gamma**key_offset, gamma**(key_offset + n_bins)).
+    key_offset: Optional[int] = None
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if not 0.0 < self.relative_accuracy < 1.0:
+            raise ValueError("Relative accuracy must be between 0 and 1.")
+        if self.n_bins < 2:
+            raise ValueError("n_bins must be >= 2")
+        if self.key_offset is None:
+            object.__setattr__(self, "key_offset", -(self.n_bins // 2))
+
+    @functools.cached_property
+    def mapping(self) -> KeyMapping:
+        return mapping_from_name(self.mapping_name, self.relative_accuracy)
+
+    @property
+    def gamma(self) -> float:
+        return self.mapping.gamma
+
+    @property
+    def min_value(self) -> float:
+        """Smallest positive value representable without low-edge collapse."""
+        return self.mapping.value(self.key_offset)
+
+    @property
+    def max_value(self) -> float:
+        """Largest positive value representable without high-edge collapse."""
+        return self.mapping.value(self.key_offset + self.n_bins - 1)
+
+    def __hash__(self):  # jnp dtypes hash fine; dataclass default is fine too
+        return hash(
+            (
+                self.relative_accuracy,
+                self.mapping_name,
+                self.n_bins,
+                self.key_offset,
+                jnp.dtype(self.dtype).name,
+            )
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SketchState:
+    """Per-batch device state: the struct-of-arrays sketch.
+
+    Field-for-field image of the reference's ``BaseDDSketch`` instance state
+    (pos store bins, neg store bins, zero_count, _count/_min/_max/_sum),
+    plus collapsed-mass observability counters (SURVEY.md section 5,
+    metrics row).
+    """
+
+    bins_pos: jax.Array  # [n_streams, n_bins]
+    bins_neg: jax.Array  # [n_streams, n_bins]
+    zero_count: jax.Array  # [n_streams]
+    count: jax.Array  # [n_streams]
+    sum: jax.Array  # [n_streams]
+    min: jax.Array  # [n_streams]
+    max: jax.Array  # [n_streams]
+    collapsed_low: jax.Array  # [n_streams] mass clamped into the low edge
+    collapsed_high: jax.Array  # [n_streams] mass clamped into the high edge
+
+    @property
+    def n_streams(self) -> int:
+        return self.bins_pos.shape[-2]
+
+    @property
+    def n_bins(self) -> int:
+        return self.bins_pos.shape[-1]
+
+
+def init(spec: SketchSpec, n_streams: int) -> SketchState:
+    """Allocate an empty batch of ``n_streams`` sketches (all shapes static)."""
+    dt = spec.dtype
+    zeros2 = jnp.zeros((n_streams, spec.n_bins), dtype=dt)
+    zeros1 = jnp.zeros((n_streams,), dtype=dt)
+    return SketchState(
+        bins_pos=zeros2,
+        bins_neg=jnp.zeros_like(zeros2),
+        zero_count=zeros1,
+        count=jnp.zeros_like(zeros1),
+        sum=jnp.zeros_like(zeros1),
+        min=jnp.full((n_streams,), jnp.inf, dtype=dt),
+        max=jnp.full((n_streams,), -jnp.inf, dtype=dt),
+        collapsed_low=jnp.zeros_like(zeros1),
+        collapsed_high=jnp.zeros_like(zeros1),
+    )
+
+
+def _keys_and_masks(spec: SketchSpec, values: jax.Array):
+    """values [.., S] -> (clamped bin index [.., S] int32, masks, clamp masks).
+
+    The branch-free analog of ``BaseDDSketch.add``'s three-way dispatch.
+    NaNs fail every comparison and land in the zero path, matching the host
+    tier's behavior.
+    """
+    v = values.astype(spec.dtype)
+    is_pos = v > jnp.asarray(0.0, spec.dtype)
+    is_neg = v < jnp.asarray(0.0, spec.dtype)
+    is_zero = jnp.logical_not(jnp.logical_or(is_pos, is_neg))
+    # Neutral operand keeps log() finite on masked lanes.
+    absv = jnp.where(is_zero, jnp.asarray(1.0, spec.dtype), jnp.abs(v))
+    keys = spec.mapping.key_array(absv)
+    lo = jnp.int32(spec.key_offset)
+    hi = jnp.int32(spec.key_offset + spec.n_bins - 1)
+    clamped_low = keys < lo
+    clamped_high = keys > hi
+    idx = jnp.clip(keys, lo, hi) - lo
+    return idx, is_pos, is_neg, is_zero, clamped_low, clamped_high
+
+
+def _row_scatter_add(bins: jax.Array, idx: jax.Array, w: jax.Array) -> jax.Array:
+    """bins [B], idx [S], w [S] -> bins with w scattered (duplicate idx sum)."""
+    return bins.at[idx].add(w)
+
+
+def add(
+    spec: SketchSpec,
+    state: SketchState,
+    values: jax.Array,
+    weights: Optional[jax.Array] = None,
+) -> SketchState:
+    """Ingest ``values[n_streams, S]`` (optionally weighted) into the batch.
+
+    Pure function; jit with ``donate_argnums`` on ``state`` so XLA updates the
+    bins in place (SURVEY.md section 7: donation or 1B/s dies on copies).
+    Entries with ``weights <= 0`` are inert padding: they contribute to no
+    counter, min/max included -- this is the static-shape idiom for ragged
+    per-stream batch sizes.  (The host tier raises ValueError on non-positive
+    weights; under jit there is no raising, so the device tier defines them
+    as padding instead -- documented divergence.)  NaN values land in the
+    zero-count path with min/max untouched and ``sum`` poisoned to NaN,
+    matching the host tier exactly.
+    """
+    v = values.astype(spec.dtype)
+    if weights is None:
+        w = jnp.ones_like(v)
+    else:
+        w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
+
+    idx, is_pos, is_neg, is_zero, clamped_low, clamped_high = _keys_and_masks(spec, v)
+    live = w > 0
+    w_pos = jnp.where(jnp.logical_and(is_pos, live), w, 0)
+    w_neg = jnp.where(jnp.logical_and(is_neg, live), w, 0)
+    w_zero = jnp.where(jnp.logical_and(is_zero, live), w, 0)
+    w_live = w_pos + w_neg + w_zero
+
+    scatter = jax.vmap(_row_scatter_add)
+    signed = w_pos + w_neg  # mass that hits a store (pos or neg)
+    inf = jnp.asarray(jnp.inf, spec.dtype)
+    # NaN values must not poison min/max (host tier: NaN comparisons are
+    # false, so _min/_max stay untouched) -- mask them out of the extrema.
+    finite_live = jnp.logical_and(live, jnp.logical_not(jnp.isnan(v)))
+    return SketchState(
+        bins_pos=scatter(state.bins_pos, idx, w_pos),
+        bins_neg=scatter(state.bins_neg, idx, w_neg),
+        zero_count=state.zero_count + w_zero.sum(-1),
+        count=state.count + w_live.sum(-1),
+        # Mask dead lanes out of v (not just the weight): NaN/inf padding with
+        # weight 0 would otherwise poison the product (NaN * 0 = NaN).  Live
+        # NaNs still poison sum, which is host-tier parity.
+        sum=state.sum + (jnp.where(live, v, 0) * w_live).sum(-1),
+        min=jnp.minimum(state.min, jnp.where(finite_live, v, inf).min(-1)),
+        max=jnp.maximum(state.max, jnp.where(finite_live, v, -inf).max(-1)),
+        collapsed_low=state.collapsed_low
+        + jnp.where(clamped_low, signed, 0).sum(-1),
+        collapsed_high=state.collapsed_high
+        + jnp.where(clamped_high, signed, 0).sum(-1),
+    )
+
+
+def _last_occupied(bins: jax.Array) -> jax.Array:
+    """Per row: largest index with bins > 0 (0 if the row is empty)."""
+    n_bins = bins.shape[-1]
+    iota = jnp.arange(n_bins, dtype=jnp.int32)
+    return jnp.max(jnp.where(bins > 0, iota, 0), axis=-1)
+
+
+def _first_occupied(bins: jax.Array) -> jax.Array:
+    """Per row: smallest index with bins > 0 (n_bins - 1 if empty)."""
+    n_bins = bins.shape[-1]
+    iota = jnp.arange(n_bins, dtype=jnp.int32)
+    return jnp.min(jnp.where(bins > 0, iota, n_bins - 1), axis=-1)
+
+
+def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
+    """Quantile values for ``qs[Q]`` across the whole batch -> ``[n_streams, Q]``.
+
+    One cumsum per store reused across every requested quantile -- the fused
+    multi-quantile query (SURVEY.md section 3.3).  The reference's per-branch
+    control flow (negative store / zero / positive store) becomes a
+    three-way ``jnp.where`` select.  Out-of-range q or an empty stream yields
+    NaN (the array-world stand-in for the reference's ``None``).
+    """
+    qs = jnp.atleast_1d(jnp.asarray(qs, spec.dtype))
+    neg_count = state.bins_neg.sum(-1)  # [N]
+    count = state.count
+    rank = qs[None, :] * (count[:, None] - 1)  # [N, Q]
+
+    cum_pos = jnp.cumsum(state.bins_pos, axis=-1)  # [N, B]
+    cum_neg = jnp.cumsum(state.bins_neg, axis=-1)
+
+    # Negative branch (reference: key_at_rank(neg_count - 1 - rank, lower=False)
+    # i.e. smallest key whose cumulative count >= r + 1 -> side='left').
+    rev_rank = neg_count[:, None] - 1 - rank
+    idx_neg = jax.vmap(
+        lambda c, r: jnp.searchsorted(c, r + 1, side="left").astype(jnp.int32)
+    )(cum_neg, rev_rank)
+    idx_neg = jnp.clip(idx_neg, _first_occupied(state.bins_neg)[:, None],
+                       _last_occupied(state.bins_neg)[:, None])
+
+    # Positive branch (lower=True -> smallest key with cum > r -> side='right').
+    pos_rank = rank - (state.zero_count + neg_count)[:, None]
+    idx_pos = jax.vmap(
+        lambda c, r: jnp.searchsorted(c, r, side="right").astype(jnp.int32)
+    )(cum_pos, pos_rank)
+    idx_pos = jnp.clip(idx_pos, _first_occupied(state.bins_pos)[:, None],
+                       _last_occupied(state.bins_pos)[:, None])
+
+    key_lo = jnp.int32(spec.key_offset)
+    val_neg = -spec.mapping.value_array(idx_neg + key_lo)
+    val_pos = spec.mapping.value_array(idx_pos + key_lo)
+
+    in_neg = rank < neg_count[:, None]
+    in_zero = rank < (neg_count + state.zero_count)[:, None]
+    out = jnp.where(in_neg, val_neg, jnp.where(in_zero, 0.0, val_pos))
+
+    valid = jnp.logical_and(
+        jnp.logical_and(qs >= 0, qs <= 1)[None, :], (count > 0)[:, None]
+    )
+    return jnp.where(valid, out, jnp.nan)
+
+
+def get_quantile_value(
+    spec: SketchSpec, state: SketchState, q: float
+) -> jax.Array:
+    """Single-quantile convenience: ``[n_streams]`` of values (NaN if empty)."""
+    return quantile(spec, state, jnp.asarray([q]))[:, 0]
+
+
+def merge(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchState:
+    """Merged batch equivalent to having ingested both streams.
+
+    The reference's ``BaseDDSketch.merge`` + ``DenseStore.merge`` with all
+    offset alignment gone: a shared static window makes merge elementwise.
+    Same-spec (same-gamma) checking lives on the host facade -- inside jit
+    both operands were traced with one ``spec``, so it holds by construction.
+    """
+    return SketchState(
+        bins_pos=a.bins_pos + b.bins_pos,
+        bins_neg=a.bins_neg + b.bins_neg,
+        zero_count=a.zero_count + b.zero_count,
+        count=a.count + b.count,
+        sum=a.sum + b.sum,
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+        collapsed_low=a.collapsed_low + b.collapsed_low,
+        collapsed_high=a.collapsed_high + b.collapsed_high,
+    )
+
+
+def merge_axis(spec: SketchSpec, state: SketchState, axis: int = 0) -> SketchState:
+    """Reduce a stacked ``[..., K, n_streams, n_bins]`` state over ``axis``.
+
+    The tree-reduction form of ``merge`` for folding K partial batches
+    (e.g. per-shard partial histograms) into one.
+    """
+    return SketchState(
+        bins_pos=state.bins_pos.sum(axis),
+        bins_neg=state.bins_neg.sum(axis),
+        zero_count=state.zero_count.sum(axis),
+        count=state.count.sum(axis),
+        sum=state.sum.sum(axis),
+        min=state.min.min(axis),
+        max=state.max.max(axis),
+        collapsed_low=state.collapsed_low.sum(axis),
+        collapsed_high=state.collapsed_high.sum(axis),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Facade
+# ---------------------------------------------------------------------------
+
+
+class BatchedDDSketch:
+    """Stateful facade over the pure batched kernel functions.
+
+    The device-tier public API: reference-shaped method names
+    (``add`` / ``get_quantile_value`` / ``merge`` -- SURVEY.md section 2 row
+    2), vectorized over ``n_streams`` sketches.  Ingest donates the state
+    pytree so XLA mutates bins in place.
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        relative_accuracy: float = DEFAULT_REL_ACC,
+        mapping: str = "logarithmic",
+        n_bins: int = DEFAULT_N_BINS,
+        key_offset: Optional[int] = None,
+        spec: Optional[SketchSpec] = None,
+        state: Optional[SketchState] = None,
+    ):
+        if spec is None:
+            spec = SketchSpec(
+                relative_accuracy=relative_accuracy,
+                mapping_name=mapping,
+                n_bins=n_bins,
+                key_offset=key_offset,
+            )
+        self.spec = spec
+        self.state = init(spec, n_streams) if state is None else state
+        self._add = jax.jit(
+            functools.partial(add, spec), donate_argnums=(0,)
+        )
+        self._quantile = jax.jit(functools.partial(quantile, spec))
+        self._merge = jax.jit(
+            functools.partial(merge, spec), donate_argnums=(0,)
+        )
+
+    # -- core API (reference-shaped, batched) ------------------------------
+    def add(self, values, weights=None) -> "BatchedDDSketch":
+        """Ingest ``values[n_streams, S]``; returns self for chaining.
+
+        A 1-D ``values`` means one value per stream.  ``weights <= 0`` entries
+        are inert padding (see :func:`add`); pass ``validate=True`` via
+        :meth:`add_validated` to reject negative weights eagerly instead.
+        """
+        values = jnp.asarray(values)
+        if weights is not None:
+            # Keep the weights' own dtype (the kernel casts to spec.dtype);
+            # casting to values.dtype would truncate fractional weights when
+            # values are integer-typed.
+            weights = jnp.asarray(weights, self.spec.dtype)
+            if weights.ndim == 1:  # per-stream weights, like 1-D values
+                weights = weights[:, None]
+        if values.ndim == 1:
+            values = values[:, None]
+        self.state = self._add(self.state, values, weights)
+        return self
+
+    def add_validated(self, values, weights=None) -> "BatchedDDSketch":
+        """Like :meth:`add` but raises on negative weights (host-tier parity).
+
+        Costs a host sync on ``weights``; keep off the hot path.
+        """
+        if weights is not None and bool(jnp.any(jnp.asarray(weights) < 0)):
+            raise ValueError("weights must be non-negative (0 = padding)")
+        return self.add(values, weights)
+
+    def get_quantile_value(self, quantile: float) -> jax.Array:
+        """Per-stream value at ``quantile`` -> ``[n_streams]`` (NaN if empty)."""
+        return self._quantile(self.state, jnp.asarray([quantile]))[:, 0]
+
+    def get_quantile_values(self, quantiles: Sequence[float]) -> jax.Array:
+        """Fused multi-quantile (e.g. p50/p90/p99/p999) -> ``[n_streams, Q]``."""
+        return self._quantile(self.state, jnp.asarray(list(quantiles)))
+
+    def merge(self, other: "BatchedDDSketch") -> "BatchedDDSketch":
+        """Fold ``other`` into self (consumes neither spec; checks mergeability)."""
+        if not self.mergeable(other):
+            from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+            raise UnequalSketchParametersError(
+                "Cannot merge two batched sketches with different specs"
+            )
+        self.state = self._merge(self.state, other.state)
+        return self
+
+    def mergeable(self, other: "BatchedDDSketch") -> bool:
+        return self.spec == other.spec
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def n_streams(self) -> int:
+        return self.state.n_streams
+
+    @property
+    def count(self) -> jax.Array:
+        return self.state.count
+
+    @property
+    def num_values(self) -> jax.Array:
+        return self.state.count
+
+    @property
+    def sum(self) -> jax.Array:  # noqa: A003 - reference API name
+        return self.state.sum
+
+    @property
+    def avg(self) -> jax.Array:
+        return self.state.sum / self.state.count
+
+    @property
+    def relative_accuracy(self) -> float:
+        return self.spec.relative_accuracy
+
+    def copy(self) -> "BatchedDDSketch":
+        return BatchedDDSketch(
+            self.n_streams,
+            spec=self.spec,
+            state=jax.tree.map(jnp.copy, self.state),
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"BatchedDDSketch(n_streams={self.n_streams},"
+            f" n_bins={self.spec.n_bins},"
+            f" relative_accuracy={self.spec.relative_accuracy},"
+            f" mapping={self.spec.mapping_name!r})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Host interop
+# ---------------------------------------------------------------------------
+
+
+def to_host_sketches(spec: SketchSpec, state: SketchState):
+    """Materialize each stream as a host-tier sketch (for serde / interop).
+
+    Returns a list of ``BaseDDSketch`` with the *spec's* mapping and
+    collapsing-lowest stores holding the same bin masses at the same keys;
+    quantile queries agree with the device path up to fp rounding.  The
+    device-only collapse counters ride along as ``_collapsed_low`` /
+    ``_collapsed_high`` attributes so ``from_host_sketches`` can round-trip
+    them.
+    """
+    from sketches_tpu.ddsketch import BaseDDSketch
+    from sketches_tpu.store import CollapsingLowestDenseStore
+
+    host = jax.device_get(
+        (state.bins_pos, state.bins_neg, state.zero_count, state.count,
+         state.sum, state.min, state.max, state.collapsed_low,
+         state.collapsed_high)
+    )
+    (bins_pos, bins_neg, zero_count, count, total, vmin, vmax,
+     clow, chigh) = host
+    sketches = []
+    for i in range(state.n_streams):
+        sk = BaseDDSketch(
+            mapping=mapping_from_name(spec.mapping_name, spec.relative_accuracy),
+            store=CollapsingLowestDenseStore(spec.n_bins),
+            negative_store=CollapsingLowestDenseStore(spec.n_bins),
+        )
+        for bins, store in (
+            (bins_pos[i], sk.store),
+            (bins_neg[i], sk.negative_store),
+        ):
+            for j in np.nonzero(bins)[0]:
+                store.add(int(j) + spec.key_offset, float(bins[j]))
+        sk._zero_count = float(zero_count[i])
+        sk._count = float(count[i])
+        sk._sum = float(total[i])
+        sk._min = float(vmin[i])
+        sk._max = float(vmax[i])
+        sk._collapsed_low = float(clow[i])
+        sk._collapsed_high = float(chigh[i])
+        sketches.append(sk)
+    return sketches
+
+
+def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
+    """Pack host-tier sketches into one batched device state.
+
+    Keys outside the spec window clamp to the edge bins (mass conserved),
+    mirroring ingest-side collapse.
+    """
+    n = len(sketches)
+    bins_pos = np.zeros((n, spec.n_bins), dtype=np.float32)
+    bins_neg = np.zeros((n, spec.n_bins), dtype=np.float32)
+    zero = np.zeros((n,), dtype=np.float32)
+    count = np.zeros((n,), dtype=np.float32)
+    total = np.zeros((n,), dtype=np.float32)
+    vmin = np.full((n,), np.inf, dtype=np.float32)
+    vmax = np.full((n,), -np.inf, dtype=np.float32)
+    clow = np.zeros((n,), dtype=np.float32)
+    chigh = np.zeros((n,), dtype=np.float32)
+    for i, sk in enumerate(sketches):
+        # Same gamma is not enough: all three mappings share gamma at equal
+        # alpha but scale the key multiplier differently, so keys are only
+        # compatible between identical mapping types.
+        if sk.mapping != spec.mapping:
+            from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+            raise UnequalSketchParametersError(
+                f"Host sketch mapping {sk.mapping!r} does not match batched"
+                f" spec mapping {spec.mapping!r}"
+            )
+        for arr, store in ((bins_pos, sk.store), (bins_neg, sk.negative_store)):
+            for key in store.keys():
+                w = store.bins[key - store.offset]
+                j = key - spec.key_offset
+                if j < 0:
+                    arr[i, 0] += w
+                    clow[i] += w
+                elif j >= spec.n_bins:
+                    arr[i, -1] += w
+                    chigh[i] += w
+                else:
+                    arr[i, j] += w
+        zero[i] = sk.zero_count
+        count[i] = sk.count
+        total[i] = sk.sum
+        vmin[i] = sk._min
+        vmax[i] = sk._max
+        # Round-trip the device-only collapse counters when present.
+        clow[i] += getattr(sk, "_collapsed_low", 0.0)
+        chigh[i] += getattr(sk, "_collapsed_high", 0.0)
+    return SketchState(
+        bins_pos=jnp.asarray(bins_pos),
+        bins_neg=jnp.asarray(bins_neg),
+        zero_count=jnp.asarray(zero),
+        count=jnp.asarray(count),
+        sum=jnp.asarray(total),
+        min=jnp.asarray(vmin),
+        max=jnp.asarray(vmax),
+        collapsed_low=jnp.asarray(clow),
+        collapsed_high=jnp.asarray(chigh),
+    )

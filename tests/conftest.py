@@ -8,11 +8,18 @@ import time in conftest.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, not setdefault: the host environment pins JAX_PLATFORMS to the real
+# TPU tunnel (and a sitecustomize hook imports jax at interpreter startup),
+# so both the env var and the runtime config must be overridden here.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after env setup by design)
+
+jax.config.update("jax_platforms", "cpu")
 # NOTE: x64 stays disabled -- the device tier is designed for f32/bf16 (TPU),
 # and tests must exercise the same numerics the hardware will.

@@ -1,0 +1,327 @@
+"""Device-tier (batched) sketch tests: accuracy, merge algebra, host parity.
+
+Mirrors the reference test strategy (SURVEY.md section 4) on the batched
+``[n_streams, n_bins]`` representation: every dataset becomes one stream of a
+single batch, so one jit'd call exercises all distributions at once.  Parity
+is asserted on quantile *values* within alpha (not bin-exactness -- SURVEY.md
+section 7 "float parity").
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sketches_tpu import DDSketch
+from sketches_tpu.batched import (
+    BatchedDDSketch,
+    SketchSpec,
+    add,
+    from_host_sketches,
+    get_quantile_value,
+    init,
+    merge,
+    merge_axis,
+    quantile,
+    to_host_sketches,
+)
+from tests.datasets import ALL_DATASETS, EPSILON, Normal
+
+TEST_REL_ACC = 0.05
+TEST_N_BINS = 1024
+TEST_QUANTILES = [0.0, 0.01, 0.05, 0.25, 0.5, 0.75, 0.95, 0.99, 1.0]
+TEST_SIZES = [3, 100, 5000]
+
+SPEC = SketchSpec(relative_accuracy=TEST_REL_ACC, n_bins=TEST_N_BINS)
+
+
+def _stack_datasets(datasets):
+    """Pad datasets to a common length -> (values[N, S], weights[N, S])."""
+    max_len = max(len(d) for d in datasets)
+    values = np.zeros((len(datasets), max_len), dtype=np.float32)
+    weights = np.zeros((len(datasets), max_len), dtype=np.float32)
+    for i, d in enumerate(datasets):
+        arr = np.asarray(list(d), dtype=np.float32)
+        values[i, : len(arr)] = arr
+        weights[i, : len(arr)] = 1.0
+    return jnp.asarray(values), jnp.asarray(weights)
+
+
+def _assert_batch_accuracy(spec, state, datasets, rel_acc=TEST_REL_ACC):
+    got = np.asarray(quantile(spec, state, jnp.asarray(TEST_QUANTILES)))
+    for i, dataset in enumerate(datasets):
+        for j, q in enumerate(TEST_QUANTILES):
+            exact = dataset.quantile(q)
+            err = abs(got[i, j] - exact)
+            assert err - rel_acc * abs(exact) <= 1e-5, (
+                type(dataset).__name__, q, exact, got[i, j],
+            )
+        assert float(state.count[i]) == pytest.approx(len(dataset))
+        assert float(state.sum[i]) == pytest.approx(dataset.sum, rel=1e-3, abs=1e-3)
+
+
+@pytest.mark.parametrize("size", TEST_SIZES)
+def test_all_distributions_one_batch(size):
+    datasets = [cls(size) for cls in ALL_DATASETS]
+    values, weights = _stack_datasets(datasets)
+    state = add(SPEC, init(SPEC, len(datasets)), values, weights)
+    _assert_batch_accuracy(SPEC, state, datasets)
+
+
+@pytest.mark.parametrize(
+    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+)
+def test_mappings_on_device_path(mapping):
+    spec = SketchSpec(
+        relative_accuracy=TEST_REL_ACC, n_bins=TEST_N_BINS, mapping_name=mapping
+    )
+    datasets = [cls(500) for cls in ALL_DATASETS]
+    values, weights = _stack_datasets(datasets)
+    state = add(spec, init(spec, len(datasets)), values, weights)
+    _assert_batch_accuracy(spec, state, datasets)
+
+
+def test_merge_semantic_equivalence():
+    """sketch(A) merge sketch(B) satisfies the same bound as sketch(A+B)."""
+    datasets = [cls(2000) for cls in ALL_DATASETS]
+    values, weights = _stack_datasets(datasets)
+    half = values.shape[1] // 2
+    s1 = add(SPEC, init(SPEC, len(datasets)), values[:, :half], weights[:, :half])
+    s2 = add(SPEC, init(SPEC, len(datasets)), values[:, half:], weights[:, half:])
+    merged = merge(SPEC, s1, s2)
+    _assert_batch_accuracy(SPEC, merged, datasets)
+    # commutativity (exact: merge is elementwise add/min/max)
+    merged_rev = merge(SPEC, s2, s1)
+    np.testing.assert_allclose(
+        np.asarray(merged.bins_pos), np.asarray(merged_rev.bins_pos)
+    )
+    np.testing.assert_allclose(np.asarray(merged.min), np.asarray(merged_rev.min))
+
+
+def test_merge_axis_tree_reduction():
+    dataset = Normal(4000)
+    vals = np.asarray(list(dataset), dtype=np.float32).reshape(4, 1, 1000)
+    parts = [add(SPEC, init(SPEC, 1), jnp.asarray(v)) for v in vals]
+    import jax
+
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+    folded = merge_axis(SPEC, stacked, axis=0)
+    got = np.asarray(quantile(SPEC, folded, jnp.asarray(TEST_QUANTILES)))[0]
+    for j, q in enumerate(TEST_QUANTILES):
+        exact = dataset.quantile(q)
+        assert abs(got[j] - exact) <= TEST_REL_ACC * abs(exact) + 1e-6
+
+
+def test_weighted_add_matches_repeated():
+    vals = jnp.asarray([[1.0, 2.5, 10.0, -4.0, 0.0]])
+    wts = jnp.asarray([[3.0, 1.0, 5.0, 2.0, 4.0]])
+    weighted = add(SPEC, init(SPEC, 1), vals, wts)
+    repeated_vals = jnp.asarray(
+        [[1.0] * 3 + [2.5] + [10.0] * 5 + [-4.0] * 2 + [0.0] * 4]
+    )
+    repeated = add(SPEC, init(SPEC, 1), repeated_vals)
+    assert float(weighted.count[0]) == float(repeated.count[0]) == 15.0
+    qs = jnp.asarray(TEST_QUANTILES)
+    np.testing.assert_allclose(
+        np.asarray(quantile(SPEC, weighted, qs)),
+        np.asarray(quantile(SPEC, repeated, qs)),
+        rtol=1e-6,
+    )
+
+
+def test_zero_weight_entries_are_inert_padding():
+    state = add(
+        SPEC,
+        init(SPEC, 1),
+        jnp.asarray([[5.0, 123.0, -77.0]]),
+        jnp.asarray([[1.0, 0.0, 0.0]]),
+    )
+    assert float(state.count[0]) == 1.0
+    assert float(state.min[0]) == 5.0
+    assert float(state.max[0]) == 5.0
+    assert float(get_quantile_value(SPEC, state, 1.0)[0]) == pytest.approx(
+        5.0, rel=TEST_REL_ACC
+    )
+
+
+def test_scatter_duplicate_keys_sum_deterministically():
+    """Duplicate keys inside one batch must accumulate, not race
+    (SURVEY.md section 5, race-detection row)."""
+    state = add(SPEC, init(SPEC, 2), jnp.full((2, 4096), 42.0))
+    assert float(state.count[0]) == 4096.0
+    assert float(state.bins_pos[0].max()) == 4096.0
+    assert float(state.bins_pos[0].sum()) == 4096.0
+
+
+def test_mass_conservation_and_collapse_counters():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=64, key_offset=-32)
+    # far outside the 64-bin window on both sides + in-window + zeros
+    vals = jnp.asarray([[1e30, 1e-30, 1.0, 0.0, -1e30]])
+    state = add(spec, init(spec, 1), vals)
+    binned = float(state.bins_pos[0].sum() + state.bins_neg[0].sum())
+    assert binned + float(state.zero_count[0]) == pytest.approx(
+        float(state.count[0])
+    )
+    assert float(state.collapsed_high[0]) == 2.0  # 1e30 and -1e30
+    assert float(state.collapsed_low[0]) == 1.0  # 1e-30
+    # collapsed values clamp to window edges: quantiles stay in range
+    q = float(get_quantile_value(spec, state, 1.0)[0])
+    assert q <= spec.max_value * (1 + spec.relative_accuracy)
+
+
+def test_empty_and_invalid_quantiles_are_nan():
+    state = init(SPEC, 2)
+    assert np.isnan(np.asarray(get_quantile_value(SPEC, state, 0.5))).all()
+    state = add(SPEC, state, jnp.asarray([[1.0], [2.0]]))
+    out = np.asarray(quantile(SPEC, state, jnp.asarray([-0.1, 0.5, 1.1])))
+    assert np.isnan(out[:, 0]).all() and np.isnan(out[:, 2]).all()
+    assert np.isfinite(out[:, 1]).all()
+
+
+def test_parity_with_host_tier():
+    """Device path vs host oracle on identical streams (SURVEY.md section 4)."""
+    datasets = [cls(1000) for cls in ALL_DATASETS]
+    values, weights = _stack_datasets(datasets)
+    state = add(SPEC, init(SPEC, len(datasets)), values, weights)
+    got = np.asarray(quantile(SPEC, state, jnp.asarray(TEST_QUANTILES)))
+    for i, dataset in enumerate(datasets):
+        host = DDSketch(TEST_REL_ACC)
+        for v in np.asarray(values[i])[np.asarray(weights[i]) > 0]:
+            host.add(float(v))
+        for j, q in enumerate(TEST_QUANTILES):
+            hq = host.get_quantile_value(q)
+            # both sides satisfy the alpha contract vs truth; against each
+            # other allow 2 alpha (SURVEY.md section 7: compare values, not bins)
+            assert abs(got[i, j] - hq) <= 2 * TEST_REL_ACC * abs(hq) + 1e-5, (
+                type(dataset).__name__, q, hq, got[i, j],
+            )
+
+
+def test_host_roundtrip():
+    datasets = [Normal(500), Normal(700)]
+    values, weights = _stack_datasets(datasets)
+    state = add(SPEC, init(SPEC, 2), values, weights)
+    sketches = to_host_sketches(SPEC, state)
+    for i, (sk, dataset) in enumerate(zip(sketches, datasets)):
+        assert sk.count == pytest.approx(float(state.count[i]))
+        for q in [0.1, 0.5, 0.9]:
+            assert sk.get_quantile_value(q) == pytest.approx(
+                float(get_quantile_value(SPEC, state, q)[i]), rel=1e-4
+            )
+    back = from_host_sketches(SPEC, sketches)
+    np.testing.assert_allclose(
+        np.asarray(back.bins_pos), np.asarray(state.bins_pos), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(back.zero_count), np.asarray(state.zero_count)
+    )
+
+
+def test_nan_inf_padding_does_not_poison_sum():
+    """weights == 0 lanes are fully inert even for NaN/inf values."""
+    state = add(
+        SPEC,
+        init(SPEC, 1),
+        jnp.asarray([[1.0, jnp.nan, jnp.inf]]),
+        jnp.asarray([[1.0, 0.0, 0.0]]),
+    )
+    assert float(state.sum[0]) == 1.0
+    assert float(state.count[0]) == 1.0
+
+
+def test_int_values_with_fractional_weights():
+    sk = BatchedDDSketch(n_streams=1, relative_accuracy=0.02)
+    sk.add(np.asarray([[1, 2]]), weights=np.asarray([[0.5, 1.5]]))
+    assert float(sk.count[0]) == pytest.approx(2.0)
+
+
+@pytest.mark.parametrize(
+    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+)
+def test_to_host_respects_spec_mapping(mapping):
+    spec = SketchSpec(relative_accuracy=0.05, n_bins=512, mapping_name=mapping)
+    state = add(spec, init(spec, 1), jnp.full((1, 100), 1e6))
+    sk = to_host_sketches(spec, state)[0]
+    dev = float(get_quantile_value(spec, state, 0.5)[0])
+    assert sk.get_quantile_value(0.5) == pytest.approx(dev, rel=1e-4)
+    assert abs(dev - 1e6) <= 0.05 * 1e6
+
+
+def test_collapse_counters_survive_host_roundtrip():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=64, key_offset=-32)
+    state = add(spec, init(spec, 1), jnp.asarray([[1e30, 1e-30, 1.0]]))
+    back = from_host_sketches(spec, to_host_sketches(spec, state))
+    assert float(back.collapsed_high[0]) == float(state.collapsed_high[0]) == 1.0
+    assert float(back.collapsed_low[0]) == float(state.collapsed_low[0]) == 1.0
+
+
+def test_nan_values_do_not_poison_min_max():
+    """Host parity: NaN comparisons are false, so _min/_max stay untouched."""
+    state = add(SPEC, init(SPEC, 1), jnp.asarray([[1.0, jnp.nan, 5.0]]))
+    assert float(state.min[0]) == 1.0
+    assert float(state.max[0]) == 5.0
+    assert float(state.zero_count[0]) == 1.0  # NaN lands in the zero path
+    assert float(state.count[0]) == 3.0
+
+
+def test_from_host_rejects_mapping_mismatch():
+    """Same gamma is not enough: mapping types scale the key multiplier
+    differently, so cross-mapping packing must raise, not corrupt."""
+    from sketches_tpu import BaseDDSketch, CubicallyInterpolatedMapping, DenseStore
+    from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+    cubic_host = BaseDDSketch(
+        mapping=CubicallyInterpolatedMapping(TEST_REL_ACC),
+        store=DenseStore(),
+        negative_store=DenseStore(),
+    )
+    cubic_host.add(1.0)
+    with pytest.raises(UnequalSketchParametersError):
+        from_host_sketches(SPEC, [cubic_host])
+
+
+class TestBatchedFacade:
+    def test_chaining_and_accessors(self):
+        sk = BatchedDDSketch(n_streams=3, relative_accuracy=0.02)
+        sk.add(jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]))
+        sk.add(jnp.asarray([10.0, 20.0, 30.0]))  # 1-D: one value per stream
+        assert np.asarray(sk.count).tolist() == [3.0, 3.0, 3.0]
+        assert float(sk.sum[0]) == pytest.approx(13.0)
+        assert float(sk.avg[1]) == pytest.approx(27.0 / 3)
+        p = np.asarray(sk.get_quantile_values([0.5, 0.99]))
+        assert p.shape == (3, 2)
+        # 1-D values with 1-D per-stream weights must promote together
+        sk.add(jnp.asarray([1.0, 1.0, 1.0]), weights=jnp.asarray([2.0, 3.0, 4.0]))
+        assert np.asarray(sk.count).tolist() == [5.0, 6.0, 7.0]
+        with pytest.raises(ValueError):
+            sk.add_validated(jnp.asarray([1.0, 1.0, 1.0]), weights=-1.0)
+
+    def test_merge_and_mergeable(self):
+        a = BatchedDDSketch(n_streams=2, relative_accuracy=0.02)
+        b = BatchedDDSketch(n_streams=2, relative_accuracy=0.02)
+        a.add(jnp.asarray([[1.0], [2.0]]))
+        b.add(jnp.asarray([[3.0], [4.0]]))
+        a.merge(b)
+        assert np.asarray(a.count).tolist() == [2.0, 2.0]
+        c = BatchedDDSketch(n_streams=2, relative_accuracy=0.05)
+        assert not a.mergeable(c)
+        from sketches_tpu import UnequalSketchParametersError
+
+        with pytest.raises(UnequalSketchParametersError):
+            a.merge(c)
+
+    def test_copy_is_deep(self):
+        a = BatchedDDSketch(n_streams=1, relative_accuracy=0.02)
+        a.add(jnp.asarray([[1.0]]))
+        c = a.copy()
+        c.add(jnp.asarray([[100.0]]))
+        assert float(a.count[0]) == 1.0
+        assert float(c.count[0]) == 2.0
+
+    def test_spec_window_properties(self):
+        spec = SketchSpec(relative_accuracy=0.01, n_bins=2048)
+        assert spec.min_value < 1e-8
+        assert spec.max_value > 1e8
+        assert math.isclose(spec.gamma, 1.01 / 0.99, rel_tol=1e-12)
