@@ -1,0 +1,307 @@
+"""Distributed tier: device-mesh sharding and collective merge.
+
+The reference's entire multi-worker story is "serialize, ship, ``merge()``"
+(reference seams: ``ddsketch/ddsketch.py . BaseDDSketch.merge``,
+``ddsketch/pb/proto.py`` -- SURVEY.md sections 2, 3.4).  On TPU that seam
+becomes XLA collectives over ICI/DCN (SURVEY.md section 5, comm-backend row):
+
+* **Stream parallelism** (the "data parallel" axis): different sketches on
+  different devices.  Nothing to communicate -- ``shard_streams`` lays the
+  ``[n_streams, n_bins]`` state over the mesh and every batched op stays
+  embarrassingly parallel under jit's sharding propagation.
+* **Value parallelism** (the reference's merge-over-workers story, and the
+  long-context analog): the *same* logical sketches ingest different chunks
+  of the value stream on each device, accumulating per-device partial
+  histograms; queries fold the partials with one ``lax.psum`` over the mesh
+  axis -- the reference's ``merge()`` become a collective.  Because merge is
+  elementwise on a shared static window (``batched.merge``), the psum IS the
+  merge -- there is no offset-alignment step to distribute.
+* Both compose on a 2-D mesh ``(streams, values)``; multi-host extends the
+  same mesh over DCN via ``jax.distributed.initialize`` + ``make_global_mesh``
+  -- the collective code is identical (the JAX runtime routes ICI vs DCN).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sketches_tpu.batched import (
+    BatchedDDSketch,
+    SketchSpec,
+    SketchState,
+    add,
+    init,
+    merge,
+    quantile,
+)
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+__all__ = [
+    "default_mesh",
+    "make_global_mesh",
+    "shard_streams",
+    "psum_merge",
+    "DistributedDDSketch",
+]
+
+
+def default_mesh(
+    axis_names: Sequence[str] = ("streams",),
+    shape: Optional[Sequence[int]] = None,
+    devices=None,
+) -> Mesh:
+    """A mesh over the local devices (1-D over all of them by default)."""
+    devices = jax.devices() if devices is None else devices
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    arr = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def make_global_mesh(
+    axis_names: Sequence[str] = ("streams",),
+    shape: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Multi-host mesh over every device in the job.
+
+    Call ``jax.distributed.initialize()`` first on each host; JAX then routes
+    intra-slice collectives over ICI and cross-slice over DCN -- the
+    NCCL/MPI-equivalent layer the reference never had (SURVEY.md section 5).
+    """
+    return default_mesh(axis_names, shape, devices=jax.devices())
+
+
+def shard_streams(
+    state: SketchState, mesh: Mesh, axis_name: str = "streams"
+) -> SketchState:
+    """Lay a batch over the mesh along the stream axis (pure data parallel).
+
+    Returns the same pytree with ``NamedSharding`` placements; jit'd batched
+    ops then run shard-local with zero communication.
+    """
+    sh2 = NamedSharding(mesh, P(axis_name, None))
+    sh1 = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(
+        lambda x: jax.device_put(x, sh2 if x.ndim == 2 else sh1), state
+    )
+
+
+def psum_merge(state: SketchState, axis_name: str) -> SketchState:
+    """Collective form of ``merge``: fold per-device partials over a mesh axis.
+
+    Must run inside ``shard_map`` (or pmap).  The reference's
+    ``DenseStore.merge`` offset-alignment loop is gone -- a shared static
+    window makes the whole merge one ``psum`` (+ pmin/pmax for bounds).
+    """
+    return SketchState(
+        bins_pos=lax.psum(state.bins_pos, axis_name),
+        bins_neg=lax.psum(state.bins_neg, axis_name),
+        zero_count=lax.psum(state.zero_count, axis_name),
+        count=lax.psum(state.count, axis_name),
+        sum=lax.psum(state.sum, axis_name),
+        min=lax.pmin(state.min, axis_name),
+        max=lax.pmax(state.max, axis_name),
+        collapsed_low=lax.psum(state.collapsed_low, axis_name),
+        collapsed_high=lax.psum(state.collapsed_high, axis_name),
+    )
+
+
+def _state_pspec(value_axis: Optional[str], stream_axis: Optional[str]) -> SketchState:
+    """PartitionSpec pytree for a partial-state stack [n_partials, N, B]."""
+    p2 = P(value_axis, stream_axis, None)
+    p1 = P(value_axis, stream_axis)
+    return SketchState(
+        bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
+        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1,
+    )
+
+
+def _merged_pspec(stream_axis: Optional[str]) -> SketchState:
+    p2 = P(stream_axis, None)
+    p1 = P(stream_axis)
+    return SketchState(
+        bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
+        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1,
+    )
+
+
+class DistributedDDSketch:
+    """Mesh-parallel sketch batch: sharded ingest, collective merge.
+
+    The TPU-native replacement for the reference's serialize-ship-merge
+    distributed pattern (SURVEY.md section 3.4).  The mesh may have
+
+    * a ``value_axis``: each device ingests a distinct chunk of every
+      stream's values into a per-device partial histogram; queries psum the
+      partials (one collective, rides ICI);
+    * a ``stream_axis``: streams themselves are sharded; no communication.
+
+    State layout: a stacked ``[n_value_shards, n_streams, n_bins]`` pytree,
+    sharded ``P(value_axis, stream_axis, None)``.  Ingest donates it.
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        mesh: Optional[Mesh] = None,
+        value_axis: Optional[str] = "values",
+        stream_axis: Optional[str] = None,
+        spec: Optional[SketchSpec] = None,
+        **spec_kwargs,
+    ):
+        if spec is None:
+            spec = SketchSpec(**spec_kwargs)
+        self.spec = spec
+        if mesh is None:
+            default_axis = value_axis or stream_axis
+            if default_axis is None:
+                raise ValueError(
+                    "Need at least one of value_axis / stream_axis (or pass"
+                    " an explicit mesh)"
+                )
+            mesh = default_mesh((default_axis,))
+        self.mesh = mesh
+        self.value_axis = value_axis
+        self.stream_axis = stream_axis
+        self.n_value_shards = mesh.shape[value_axis] if value_axis else 1
+        self.n_streams = n_streams
+
+        state_spec = _state_pspec(value_axis, stream_axis)
+        merged_spec = _merged_pspec(stream_axis)
+        vspec = P(stream_axis, value_axis)
+        mesh_axes = tuple(n for n in (value_axis, stream_axis) if n)
+
+        def local_ingest(partials, values, weights):
+            st = jax.tree.map(lambda x: x[0], partials)
+            st = add(spec, st, values, weights)
+            return jax.tree.map(lambda x: x[None], st)
+
+        def fold(partials):
+            st = jax.tree.map(lambda x: x[0], partials)
+            if value_axis:
+                st = psum_merge(st, value_axis)
+            return st
+
+        self._ingest = jax.jit(
+            shard_map(
+                local_ingest,
+                mesh=mesh,
+                in_specs=(state_spec, vspec, vspec),
+                out_specs=state_spec,
+            ),
+            donate_argnums=(0,),
+        )
+        self._fold = jax.jit(
+            shard_map(
+                fold, mesh=mesh, in_specs=(state_spec,), out_specs=merged_spec
+            )
+        )
+        self._quantile = jax.jit(functools.partial(quantile, spec))
+        self._merge_partials = jax.jit(
+            functools.partial(merge, spec), donate_argnums=(0,)
+        )
+
+        stacked = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.n_value_shards,) + x.shape),
+            init(spec, n_streams),
+        )
+        sharding = jax.tree.map(
+            lambda ps: NamedSharding(mesh, ps), state_spec
+        )
+        self.partials: SketchState = jax.tree.map(
+            jax.device_put, stacked, sharding
+        )
+        self._merged_cache: Optional[SketchState] = None
+
+    # -- core API ----------------------------------------------------------
+    def add(self, values, weights=None) -> "DistributedDDSketch":
+        """Ingest ``values[n_streams, S]``; S must divide by n_value_shards.
+
+        Use ``weights == 0`` entries to pad ragged batches to a multiple.
+        """
+        values = jnp.asarray(values)
+        if values.ndim == 1:
+            values = values[:, None]
+        if values.shape[-1] % self.n_value_shards:
+            raise ValueError(
+                f"values width {values.shape[-1]} must be divisible by the"
+                f" {self.n_value_shards}-way {self.value_axis!r} mesh axis;"
+                " pad with weights=0 entries"
+            )
+        if weights is None:
+            weights = jnp.ones(values.shape, dtype=self.spec.dtype)
+        else:
+            weights = jnp.asarray(weights, self.spec.dtype)
+            if weights.ndim == 1:  # per-stream weights (batched-facade parity)
+                weights = weights[:, None]
+            weights = jnp.broadcast_to(weights, values.shape)
+        self.partials = self._ingest(self.partials, values, weights)
+        self._merged_cache = None
+        return self
+
+    def merged_state(self) -> SketchState:
+        """Fold partials into one ``[n_streams, n_bins]`` batch (the psum merge).
+
+        Cached between ingests so back-to-back accessor/query calls pay for
+        one collective, not one each.
+        """
+        if self._merged_cache is None:
+            self._merged_cache = self._fold(self.partials)
+        return self._merged_cache
+
+    def get_quantile_value(self, q: float) -> jax.Array:
+        return self._quantile(self.merged_state(), jnp.asarray([q]))[:, 0]
+
+    def get_quantile_values(self, qs: Sequence[float]) -> jax.Array:
+        return self._quantile(self.merged_state(), jnp.asarray(list(qs)))
+
+    def merge(self, other: "DistributedDDSketch") -> "DistributedDDSketch":
+        """Fold another distributed batch into this one (elementwise, no comms)."""
+        if self.spec != other.spec:
+            from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+            raise UnequalSketchParametersError(
+                "Cannot merge distributed sketches with different specs"
+            )
+        self.partials = self._merge_partials(self.partials, other.partials)
+        self._merged_cache = None
+        return self
+
+    def to_batched(self) -> BatchedDDSketch:
+        """Materialize as a single-batch facade (for serde / checkpointing).
+
+        Deep-copies the merged state: the facade's donating jits would
+        otherwise delete buffers this object still references via its cache.
+        """
+        return BatchedDDSketch(
+            self.n_streams,
+            spec=self.spec,
+            state=jax.tree.map(jnp.copy, self.merged_state()),
+        )
+
+    # -- accessors ---------------------------------------------------------
+    @property
+    def count(self) -> jax.Array:
+        return self.merged_state().count
+
+    @property
+    def sum(self) -> jax.Array:  # noqa: A003 - reference API name
+        return self.merged_state().sum
+
+    def __repr__(self) -> str:
+        return (
+            f"DistributedDDSketch(n_streams={self.n_streams},"
+            f" mesh={dict(self.mesh.shape)},"
+            f" value_axis={self.value_axis!r}, stream_axis={self.stream_axis!r})"
+        )

@@ -1,0 +1,190 @@
+"""Distributed-tier tests on the virtual 8-device CPU mesh.
+
+The reference tests "multi-node" as pure merge algebra in one process
+(SURVEY.md section 4); here the same semantic-equivalence assertions run
+against real shard_map + psum collectives over the forced 8-device CPU mesh
+(conftest sets ``xla_force_host_platform_device_count=8``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sketches_tpu.batched import SketchSpec, add, init, quantile
+from sketches_tpu.parallel import (
+    DistributedDDSketch,
+    default_mesh,
+    shard_streams,
+)
+from tests.datasets import Lognormal, Normal, NumberLineBackward
+
+TEST_REL_ACC = 0.05
+QS = [0.01, 0.25, 0.5, 0.75, 0.99]
+SPEC = SketchSpec(relative_accuracy=TEST_REL_ACC, n_bins=512)
+
+
+def _rows(dataset_cls, n_streams, size):
+    out = np.zeros((n_streams, size), dtype=np.float32)
+    for i in range(n_streams):
+        out[i] = np.asarray(list(dataset_cls(size + i))[:size], dtype=np.float32)
+    return out
+
+
+def test_mesh_has_8_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_value_parallel_matches_single_device():
+    """Sharded ingest + psum merge == unsharded ingest (merge-as-collective)."""
+    values = _rows(Normal, 4, 4096)
+    dist = DistributedDDSketch(n_streams=4, spec=SPEC)
+    dist.add(values)
+    merged = dist.merged_state()
+
+    ref = add(SPEC, init(SPEC, 4), jnp.asarray(values))
+    np.testing.assert_allclose(
+        np.asarray(merged.count), np.asarray(ref.count), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(merged.bins_pos), np.asarray(ref.bins_pos), rtol=1e-5
+    )
+    got = np.asarray(quantile(SPEC, merged, jnp.asarray(QS)))
+    want = np.asarray(quantile(SPEC, ref, jnp.asarray(QS)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_value_parallel_accuracy_contract():
+    size = 4000
+    datasets = [Normal(size), Lognormal(size), NumberLineBackward(size)]
+    values = np.stack(
+        [np.asarray(list(d), dtype=np.float32) for d in datasets]
+    )
+    dist = DistributedDDSketch(n_streams=3, spec=SPEC)
+    dist.add(values)
+    got = np.asarray(dist.get_quantile_values(QS))
+    for i, d in enumerate(datasets):
+        for j, q in enumerate(QS):
+            exact = d.quantile(q)
+            assert abs(got[i, j] - exact) <= TEST_REL_ACC * abs(exact) + 1e-5
+
+
+def test_incremental_adds_accumulate_across_devices():
+    dist = DistributedDDSketch(n_streams=2, spec=SPEC)
+    chunk = np.ones((2, 8), dtype=np.float32)
+    for _ in range(5):
+        dist.add(chunk * np.float32(np.random.RandomState(0).uniform(1, 2)))
+    assert np.asarray(dist.count).tolist() == [40.0, 40.0]
+
+
+def test_ragged_padding_with_zero_weights():
+    dist = DistributedDDSketch(n_streams=1, spec=SPEC)
+    values = np.zeros((1, 8), dtype=np.float32)
+    values[0, :3] = [1.0, 2.0, 3.0]
+    weights = np.zeros((1, 8), dtype=np.float32)
+    weights[0, :3] = 1.0
+    dist.add(values, weights)
+    assert float(np.asarray(dist.count)[0]) == 3.0
+    mid = float(np.asarray(dist.get_quantile_value(0.5))[0])
+    assert abs(mid - 2.0) <= TEST_REL_ACC * 2.0 + 1e-6
+
+
+def test_stream_axis_only_distributed():
+    """value_axis=None + stream_axis: pure stream parallelism, no collectives."""
+    dist = DistributedDDSketch(
+        n_streams=8, value_axis=None, stream_axis="streams", spec=SPEC
+    )
+    values = _rows(Normal, 8, 128)
+    dist.add(values)
+    got = np.asarray(dist.get_quantile_values(QS))
+    ref = add(SPEC, init(SPEC, 8), jnp.asarray(values))
+    want = np.asarray(quantile(SPEC, ref, jnp.asarray(QS)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_no_axes_at_all_raises():
+    with pytest.raises(ValueError, match="at least one"):
+        DistributedDDSketch(n_streams=1, value_axis=None, stream_axis=None)
+
+
+def test_indivisible_width_raises():
+    dist = DistributedDDSketch(n_streams=1, spec=SPEC)
+    with pytest.raises(ValueError, match="divisible"):
+        dist.add(np.ones((1, 5), dtype=np.float32))
+
+
+def test_merge_of_distributed_batches():
+    a = DistributedDDSketch(n_streams=2, spec=SPEC)
+    b = DistributedDDSketch(n_streams=2, spec=SPEC)
+    va, vb = _rows(Normal, 2, 1024), _rows(Lognormal, 2, 1024)
+    a.add(va)
+    b.add(vb)
+    a.merge(b)
+    both = np.concatenate([va, vb], axis=1)
+    ref = add(SPEC, init(SPEC, 2), jnp.asarray(both))
+    np.testing.assert_allclose(
+        np.asarray(a.merged_state().bins_pos), np.asarray(ref.bins_pos), rtol=1e-5
+    )
+    c = DistributedDDSketch(n_streams=2, relative_accuracy=0.2)
+    from sketches_tpu import UnequalSketchParametersError
+
+    with pytest.raises(UnequalSketchParametersError):
+        a.merge(c)
+
+
+def test_2d_mesh_streams_by_values():
+    """dp (streams) x "sp" (values) on a (2, 4) mesh -- both axes at once."""
+    mesh = default_mesh(("streams", "values"), shape=(2, 4))
+    values = _rows(Normal, 4, 2048)
+    dist = DistributedDDSketch(
+        n_streams=4,
+        mesh=mesh,
+        value_axis="values",
+        stream_axis="streams",
+        spec=SPEC,
+    )
+    dist.add(values)
+    ref = add(SPEC, init(SPEC, 4), jnp.asarray(values))
+    got = np.asarray(dist.get_quantile_values(QS))
+    want = np.asarray(quantile(SPEC, ref, jnp.asarray(QS)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_stream_sharded_layout_preserved_under_jit():
+    """Pure stream parallelism: ops keep the NamedSharding, no collectives."""
+    mesh = default_mesh(("streams",))
+    state = shard_streams(init(SPEC, 16), mesh)
+    values = jnp.asarray(_rows(Normal, 16, 256))
+    values = jax.device_put(
+        values, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("streams", None))
+    )
+    import functools
+
+    step = jax.jit(functools.partial(add, SPEC), donate_argnums=(0,))
+    out = step(state, values, None)
+    shardings = {
+        tuple(s.spec) for s in jax.tree.leaves(jax.tree.map(lambda x: x.sharding, out))
+    }
+    assert ("streams", None) in shardings or ("streams",) in shardings
+    got = np.asarray(quantile(SPEC, out, jnp.asarray([0.5])))
+    assert np.isfinite(got).all()
+
+
+def test_to_batched_roundtrip():
+    dist = DistributedDDSketch(n_streams=2, spec=SPEC)
+    dist.add(_rows(Normal, 2, 512))
+    batched = dist.to_batched()
+    got = np.asarray(batched.get_quantile_values(QS))
+    want = np.asarray(dist.get_quantile_values(QS))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # mutating the materialized facade (whose jits donate buffers) must not
+    # invalidate the distributed object's own state
+    batched.add(jnp.asarray([[1.0], [2.0]]))
+    assert np.asarray(dist.count).tolist() == [512.0, 512.0]
+
+
+def test_per_stream_1d_weights_match_batched_facade():
+    dist = DistributedDDSketch(n_streams=2, spec=SPEC)
+    dist.add(np.ones((2, 8), dtype=np.float32), weights=np.asarray([2.0, 3.0]))
+    assert np.asarray(dist.count).tolist() == [16.0, 24.0]
