@@ -1,0 +1,112 @@
+"""Headline benchmark: batched ingest throughput on the current device.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is the
+ratio against the reference-equivalent path measured in-process: the
+host-tier pure-Python ``DDSketch.add`` loop (BASELINE.json configs[0]),
+which is behaviorally identical to the reference's hot path.  Extra keys
+report the engine used and the fused multi-quantile query latency
+(north-star metric #2).
+
+Timing uses ``jax.device_get`` as the sync point -- ``block_until_ready``
+does not reliably synchronize through the axon tunnel.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def _bench_device_ingest(n_streams: int = 4096, batch: int = 2048, iters: int = 20):
+    import jax
+    import jax.numpy as jnp
+
+    from sketches_tpu import kernels
+    from sketches_tpu.batched import SketchSpec, add, init
+
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=2048)
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = on_tpu and kernels.supports(spec, n_streams, batch)
+    if use_pallas:
+        step = jax.jit(
+            functools.partial(kernels.add, spec), donate_argnums=(0,)
+        )
+        qfn = jax.jit(functools.partial(kernels.fused_quantile, spec))
+    else:
+        from sketches_tpu.batched import quantile
+
+        step = jax.jit(functools.partial(add, spec), donate_argnums=(0,))
+        qfn = jax.jit(functools.partial(quantile, spec))
+
+    state = init(spec, n_streams)
+    values = jnp.asarray(
+        np.random.RandomState(0)
+        .lognormal(0.0, 2.0, (n_streams, batch))
+        .astype(np.float32)
+    )
+    weights = jnp.ones_like(values)
+
+    state = step(state, values, weights)  # compile + warm
+    _ = jax.device_get(state.count[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(state, values, weights)
+    _ = jax.device_get(state.count[:1])
+    dt = time.perf_counter() - t0
+    ingest_per_s = n_streams * batch * iters / dt
+
+    # Fused multi-quantile query latency over the full batch.
+    qs = jnp.asarray([0.5, 0.9, 0.99, 0.999], dtype=jnp.float32)
+    out = qfn(state, qs)
+    _ = jax.device_get(out[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = qfn(state, qs)
+    _ = jax.device_get(out[:1])
+    query_s = (time.perf_counter() - t0) / iters
+    return ingest_per_s, query_s, "pallas" if use_pallas else "xla"
+
+
+def _bench_host_baseline(n: int = 200_000) -> float:
+    """Reference-equivalent pure-Python ingest rate (values/s)."""
+    from sketches_tpu import DDSketch
+
+    values = np.random.RandomState(0).lognormal(0.0, 2.0, n).tolist()
+    sk = DDSketch(0.01)
+    t0 = time.perf_counter()
+    for v in values:
+        sk.add(v)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    import jax
+
+    device = jax.devices()[0]
+    ingest_per_s, query_s, engine = _bench_device_ingest()
+    baseline = _bench_host_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "batched_ingest_throughput",
+                "value": round(ingest_per_s, 1),
+                "unit": "values/s",
+                "vs_baseline": round(ingest_per_s / baseline, 2),
+                "baseline_host_add_per_s": round(baseline, 1),
+                "multi_quantile_query_s": round(query_s, 6),
+                "engine": engine,
+                "device": str(device),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

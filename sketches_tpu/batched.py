@@ -384,6 +384,7 @@ class BatchedDDSketch:
         key_offset: Optional[int] = None,
         spec: Optional[SketchSpec] = None,
         state: Optional[SketchState] = None,
+        engine: str = "auto",
     ):
         if spec is None:
             spec = SketchSpec(
@@ -394,10 +395,43 @@ class BatchedDDSketch:
             )
         self.spec = spec
         self.state = init(spec, n_streams) if state is None else state
-        self._add = jax.jit(
+        if engine not in ("auto", "xla", "pallas"):
+            raise ValueError(f"Unknown engine {engine!r}")
+        # 'auto': the Pallas kernels on TPU when the config qualifies, the
+        # portable XLA path otherwise.  'pallas' forces the kernels (in
+        # interpreter mode off-TPU -- for tests).
+        from sketches_tpu import kernels
+
+        if engine == "pallas" and not kernels.supports(spec, n_streams):
+            raise ValueError(
+                "engine='pallas' requires the logarithmic mapping, 128-aligned"
+                f" n_bins and n_streams; got {spec} with n_streams={n_streams}"
+            )
+        use_pallas = engine == "pallas" or (
+            engine == "auto"
+            and jax.default_backend() == "tpu"
+            and kernels.supports(spec, n_streams)
+        )
+        self.engine = "pallas" if use_pallas else "xla"
+        # The XLA add stays available even on the Pallas engine: it takes the
+        # batch widths and weighted adds the kernels do not.
+        self._add_xla = jax.jit(
             functools.partial(add, spec), donate_argnums=(0,)
         )
-        self._quantile = jax.jit(functools.partial(quantile, spec))
+        if use_pallas:
+            interpret = jax.default_backend() != "tpu"
+            self._add_pallas = jax.jit(
+                functools.partial(kernels.add, spec, interpret=interpret),
+                donate_argnums=(0,),
+            )
+            self._quantile = jax.jit(
+                functools.partial(kernels.fused_quantile, spec, interpret=interpret)
+            )
+            self._batch_ok = lambda s: kernels.supports(spec, n_streams, s)
+        else:
+            self._add_pallas = None
+            self._quantile = jax.jit(functools.partial(quantile, spec))
+            self._batch_ok = lambda s: False
         self._merge = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
@@ -420,7 +454,16 @@ class BatchedDDSketch:
                 weights = weights[:, None]
         if values.ndim == 1:
             values = values[:, None]
-        self.state = self._add(self.state, values, weights)
+        # Weighted adds take the XLA engine: the kernel's bf16 one-hot operand
+        # quantizes non-integer weights (see kernels.add).
+        if (
+            self._add_pallas is not None
+            and weights is None
+            and self._batch_ok(values.shape[-1])
+        ):
+            self.state = self._add_pallas(self.state, values, weights)
+        else:
+            self.state = self._add_xla(self.state, values, weights)
         return self
 
     def add_validated(self, values, weights=None) -> "BatchedDDSketch":

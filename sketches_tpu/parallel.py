@@ -187,6 +187,11 @@ class DistributedDDSketch:
             st = add(spec, st, values, weights)
             return jax.tree.map(lambda x: x[None], st)
 
+        def local_ingest_unweighted(partials, values):
+            # Unit weights are built shard-locally instead of shipping a
+            # dense ones tensor through the mesh alongside the values.
+            return local_ingest(partials, values, None)
+
         def fold(partials):
             st = jax.tree.map(lambda x: x[0], partials)
             if value_axis:
@@ -198,6 +203,15 @@ class DistributedDDSketch:
                 local_ingest,
                 mesh=mesh,
                 in_specs=(state_spec, vspec, vspec),
+                out_specs=state_spec,
+            ),
+            donate_argnums=(0,),
+        )
+        self._ingest_unweighted = jax.jit(
+            shard_map(
+                local_ingest_unweighted,
+                mesh=mesh,
+                in_specs=(state_spec, vspec),
                 out_specs=state_spec,
             ),
             donate_argnums=(0,),
@@ -240,13 +254,13 @@ class DistributedDDSketch:
                 " pad with weights=0 entries"
             )
         if weights is None:
-            weights = jnp.ones(values.shape, dtype=self.spec.dtype)
+            self.partials = self._ingest_unweighted(self.partials, values)
         else:
             weights = jnp.asarray(weights, self.spec.dtype)
             if weights.ndim == 1:  # per-stream weights (batched-facade parity)
                 weights = weights[:, None]
             weights = jnp.broadcast_to(weights, values.shape)
-        self.partials = self._ingest(self.partials, values, weights)
+            self.partials = self._ingest(self.partials, values, weights)
         self._merged_cache = None
         return self
 

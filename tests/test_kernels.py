@@ -1,0 +1,167 @@
+"""Pallas kernel tests (interpreter mode on the CPU mesh).
+
+Parity contract: the kernels are alternative *engines* over the same state
+layout, so every test asserts exact agreement (up to fp tolerance) with the
+portable XLA path in ``sketches_tpu.batched`` -- same bins, same counters,
+same quantiles, same NaN semantics.  Real-TPU parity of the same kernels is
+exercised by bench.py on hardware.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sketches_tpu import kernels
+from sketches_tpu.batched import (
+    BatchedDDSketch,
+    SketchSpec,
+    add as xla_add,
+    init,
+    quantile as xla_quantile,
+)
+
+SPEC = SketchSpec(relative_accuracy=0.01, n_bins=2048)
+N, S = 128, 256  # one kernel block of streams, two value chunks
+
+
+def _mixed_values():
+    vals = np.random.RandomState(0).lognormal(0, 2, (N, S)).astype(np.float32)
+    vals[:, ::7] *= -1.0
+    vals[:, ::11] = 0.0
+    vals[0, :4] = [1e30, -1e30, 1e-30, np.nan]
+    return vals
+
+
+def test_supports():
+    assert kernels.supports(SPEC, 128)
+    assert kernels.supports(SPEC, 128, 256)
+    assert not kernels.supports(SPEC, 100)  # stream block misaligned
+    assert not kernels.supports(SPEC, 128, 100)  # batch misaligned
+    assert not kernels.supports(
+        SketchSpec(relative_accuracy=0.01, n_bins=100), 128
+    )  # bins not 128-aligned
+    assert not kernels.supports(
+        SketchSpec(relative_accuracy=0.01, mapping_name="cubic_interpolated"), 128
+    )  # only the logarithmic mapping lowers
+
+
+def test_ingest_parity_with_xla():
+    vals = jnp.asarray(_mixed_values())
+    w = np.ones((N, S), np.float32)
+    w[0, 5] = 2.0
+    w[1, :10] = 0.0  # padding
+    w = jnp.asarray(w)
+    ref = xla_add(SPEC, init(SPEC, N), vals, w)
+    got = kernels.add(SPEC, init(SPEC, N), vals, w, interpret=True)
+    for f in (
+        "bins_pos", "bins_neg", "zero_count", "count", "sum", "min", "max",
+        "collapsed_low", "collapsed_high",
+    ):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, f)),
+            np.asarray(getattr(ref, f)),
+            rtol=1e-5,
+            atol=1e-5,
+            err_msg=f,
+        )
+
+
+def test_quantile_parity_with_xla():
+    vals = jnp.asarray(_mixed_values())
+    state = xla_add(SPEC, init(SPEC, N), vals)
+    qs = jnp.asarray([-0.1, 0.0, 0.25, 0.5, 0.9, 0.99, 1.0, 1.5])
+    ref = np.asarray(xla_quantile(SPEC, state, qs))
+    got = np.asarray(kernels.fused_quantile(SPEC, state, qs, interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, equal_nan=True)
+
+
+def test_quantile_empty_streams_are_nan():
+    state = init(SPEC, N)
+    got = np.asarray(
+        kernels.fused_quantile(SPEC, state, jnp.asarray([0.5]), interpret=True)
+    )
+    assert np.isnan(got).all()
+
+
+def test_facade_pallas_engine():
+    sk = BatchedDDSketch(n_streams=N, spec=SPEC, engine="pallas")
+    assert sk.engine == "pallas"
+    vals = _mixed_values()
+    sk.add(vals)
+    ref = BatchedDDSketch(n_streams=N, spec=SPEC, engine="xla").add(vals)
+    np.testing.assert_allclose(
+        np.asarray(sk.get_quantile_values([0.5, 0.99])),
+        np.asarray(ref.get_quantile_values([0.5, 0.99])),
+        rtol=1e-4,
+        equal_nan=True,
+    )
+    # misaligned batch widths silently take the XLA fallback
+    sk.add(np.ones((N, 3), np.float32))
+    assert float(sk.count[1]) == float(ref.count[1]) + 3.0
+
+
+def test_facade_pallas_engine_rejects_unsupported_config():
+    with pytest.raises(ValueError, match="pallas"):
+        BatchedDDSketch(n_streams=64, spec=SPEC, engine="pallas")
+    with pytest.raises(ValueError, match="pallas"):
+        BatchedDDSketch(
+            n_streams=128,
+            relative_accuracy=0.01,
+            mapping="cubic_interpolated",
+            engine="pallas",
+        )
+
+
+def test_facade_routes_weighted_adds_to_xla():
+    """Fractional weights must stay exact (kernel bf16 operand would not)."""
+    sk = BatchedDDSketch(n_streams=N, spec=SPEC, engine="pallas")
+    w = np.full((N, S), 1000.5, np.float32)
+    vals = np.full((N, S), 2.0, np.float32)
+    sk.add(vals, weights=w)
+    assert float(sk.count[0]) == pytest.approx(1000.5 * S, rel=1e-6)
+    assert float(np.asarray(sk.state.bins_pos[0]).sum()) == pytest.approx(
+        1000.5 * S, rel=1e-6
+    )
+
+
+def test_kernel_counters_match_masks_at_window_edges():
+    """Kernel-side clamp accounting must agree with the XLA masks exactly."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128, key_offset=-64)
+    vals = np.ones((128, 128), np.float32)
+    vals[:, 0] = 1e30
+    vals[:, 1] = 1e-30
+    vals[:, 2] = -1e30
+    ref = xla_add(spec, init(spec, 128), jnp.asarray(vals))
+    got = kernels.add(spec, init(spec, 128), jnp.asarray(vals), interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got.collapsed_low), np.asarray(ref.collapsed_low)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.collapsed_high), np.asarray(ref.collapsed_high)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.bins_pos), np.asarray(ref.bins_pos)
+    )
+
+
+def test_facade_auto_engine_off_tpu_is_xla():
+    sk = BatchedDDSketch(n_streams=N, spec=SPEC, engine="auto")
+    assert sk.engine == "xla"  # tests run on the CPU mesh
+    with pytest.raises(ValueError, match="engine"):
+        BatchedDDSketch(n_streams=N, spec=SPEC, engine="bogus")
+
+
+def test_accuracy_contract_through_kernel():
+    """End to end: kernel-built sketch satisfies the alpha bound."""
+    data = np.random.RandomState(1).lognormal(0, 2, (N, S)).astype(np.float32)
+    state = kernels.add(SPEC, init(SPEC, N), jnp.asarray(data), interpret=True)
+    got = np.asarray(
+        kernels.fused_quantile(
+            SPEC, state, jnp.asarray([0.25, 0.5, 0.99]), interpret=True
+        )
+    )
+    for i in range(0, N, 16):
+        for j, q in enumerate([0.25, 0.5, 0.99]):
+            exact = np.quantile(data[i], q, method="lower")
+            assert abs(got[i, j] - exact) <= 0.0102 * abs(exact) + 1e-9
