@@ -17,6 +17,7 @@ Two execution tiers:
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
+    JaxDDSketch,
     LogCollapsingHighestDenseDDSketch,
     LogCollapsingLowestDenseDDSketch,
     UnequalSketchParametersError,
@@ -33,12 +34,15 @@ from sketches_tpu.store import (
     DenseStore,
     Store,
 )
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
+from sketches_tpu.parallel import DistributedDDSketch
 
 __version__ = "0.1.0"
 
 __all__ = [
     "BaseDDSketch",
     "DDSketch",
+    "JaxDDSketch",
     "LogCollapsingLowestDenseDDSketch",
     "LogCollapsingHighestDenseDDSketch",
     "UnequalSketchParametersError",
@@ -50,5 +54,9 @@ __all__ = [
     "DenseStore",
     "CollapsingLowestDenseStore",
     "CollapsingHighestDenseStore",
+    "BatchedDDSketch",
+    "SketchSpec",
+    "SketchState",
+    "DistributedDDSketch",
     "__version__",
 ]

@@ -1,0 +1,79 @@
+"""Checkpoint / resume for device-tier sketch batches.
+
+The reference's only durable format is the protobuf round-trip (SURVEY.md
+section 5, checkpoint row); that stays the cross-language edge
+(``sketches_tpu.pb``).  Bulk checkpoints of a ``[n_streams, n_bins]`` batch
+go through this module instead: one ``device_get`` into a compressed npz of
+the raw state arrays plus the spec, and ``device_put`` back on restore --
+sketch state is one dense pytree, so checkpoint/resume is exactly an array
+save/load, no orchestration needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
+
+__all__ = ["save", "restore", "save_state", "restore_state"]
+
+_FIELDS = [f.name for f in dataclasses.fields(SketchState)]
+
+
+def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
+    """Write spec + state to ``path`` (npz; host round-trip, compressed)."""
+    arrays = {name: np.asarray(jax.device_get(getattr(state, name)))
+              for name in _FIELDS}
+    spec_json = json.dumps(
+        {
+            "relative_accuracy": spec.relative_accuracy,
+            "mapping_name": spec.mapping_name,
+            "n_bins": spec.n_bins,
+            "key_offset": spec.key_offset,
+            "dtype": jnp.dtype(spec.dtype).name,
+        }
+    )
+    np.savez_compressed(path, __spec__=np.frombuffer(spec_json.encode(), np.uint8),
+                        **arrays)
+
+
+def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
+    """Load (spec, state) previously written by ``save_state``."""
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__spec__"]).decode())
+        spec = SketchSpec(
+            relative_accuracy=meta["relative_accuracy"],
+            mapping_name=meta["mapping_name"],
+            n_bins=meta["n_bins"],
+            key_offset=meta["key_offset"],
+            dtype=jnp.dtype(meta["dtype"]),
+        )
+        state = SketchState(
+            **{name: jnp.asarray(data[name]) for name in _FIELDS}
+        )
+    return spec, state
+
+
+def save(path: str, sketch: Union[BatchedDDSketch, "DistributedDDSketch"]) -> None:  # noqa: F821
+    """Checkpoint a batched (or distributed -- folded first) sketch facade."""
+    from sketches_tpu.parallel import DistributedDDSketch
+
+    if isinstance(sketch, DistributedDDSketch):
+        save_state(path, sketch.spec, sketch.merged_state())
+    else:
+        save_state(path, sketch.spec, sketch.state)
+
+
+def restore(path: str, engine: str = "auto") -> BatchedDDSketch:
+    """Resume a checkpoint as a batched facade (engine re-selected here)."""
+    spec, state = restore_state(path)
+    return BatchedDDSketch(
+        state.n_streams, spec=spec, state=state, engine=engine
+    )

@@ -20,8 +20,11 @@ sketches, use ``BatchedDDSketch`` directly.
 
 from __future__ import annotations
 
+import functools
 import math
 import typing
+
+import numpy as np
 
 from sketches_tpu.mapping import KeyMapping, LogarithmicMapping
 from sketches_tpu.store import (
@@ -35,6 +38,7 @@ __all__ = [
     "UnequalSketchParametersError",
     "BaseDDSketch",
     "DDSketch",
+    "JaxDDSketch",
     "LogCollapsingLowestDenseDDSketch",
     "LogCollapsingHighestDenseDDSketch",
 ]
@@ -169,8 +173,10 @@ class BaseDDSketch:
             self._copy(sketch)
             return
 
-        self._store.merge(sketch._store)
-        self._negative_store.merge(sketch._negative_store)
+        # Public accessors, not _store: a jax-backed operand materializes its
+        # device bins as host stores through these properties.
+        self._store.merge(sketch.store)
+        self._negative_store.merge(sketch.negative_store)
         self._zero_count += sketch._zero_count
 
         self._count += sketch._count
@@ -185,8 +191,8 @@ class BaseDDSketch:
         return self._mapping.gamma == other._mapping.gamma
 
     def _copy(self, sketch: "BaseDDSketch") -> None:
-        self._store = sketch._store.copy()
-        self._negative_store = sketch._negative_store.copy()
+        self._store = sketch.store.copy()
+        self._negative_store = sketch.negative_store.copy()
         self._zero_count = sketch._zero_count
         self._count = sketch._count
         self._sum = sketch._sum
@@ -200,13 +206,192 @@ class BaseDDSketch:
         return new
 
 
+class JaxDDSketch(BaseDDSketch):
+    """Single-sketch facade over the device tier: reference API, JAX bins.
+
+    The ``backend='jax'`` seam (BASELINE.json north star: same public API,
+    device path underneath).  Scalar ``add`` calls buffer on the host and
+    flush to a 1-stream slice of the batched device state in fixed-size
+    chunks (fixed so one jit compilation serves every flush); queries and
+    merges flush first.  Scalar bookkeeping (count/sum/min/max) stays in
+    host float64 -- strictly more precise than the reference's -- while bin
+    mass lives on device.
+
+    Deliberately *not* a subclass of ``DDSketch``: ``DDSketch.__new__``
+    returns one of these when asked for the jax backend, and Python then
+    skips ``DDSketch.__init__`` because the returned object is not a
+    ``DDSketch`` instance.
+    """
+
+    _FLUSH_CHUNK = 4096
+
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _jitted_ops(spec):
+        """One set of compiled (add, quantile, merge) per spec, shared by
+        every instance (and every ``copy()``) with that spec."""
+        import jax
+
+        from sketches_tpu import batched
+
+        return (
+            jax.jit(functools.partial(batched.add, spec), donate_argnums=(0,)),
+            jax.jit(functools.partial(batched.get_quantile_value, spec)),
+            jax.jit(functools.partial(batched.merge, spec), donate_argnums=(0,)),
+        )
+
+    def __init__(
+        self,
+        relative_accuracy: typing.Optional[float] = None,
+        n_bins: typing.Optional[int] = None,
+    ):
+        from sketches_tpu import batched
+
+        if relative_accuracy is None:
+            relative_accuracy = DEFAULT_REL_ACC
+        self._spec = batched.SketchSpec(
+            relative_accuracy=relative_accuracy,
+            n_bins=DEFAULT_BIN_LIMIT if n_bins is None else n_bins,
+        )
+        self._mapping = LogarithmicMapping(relative_accuracy)
+        self._relative_accuracy = relative_accuracy
+        self._state = batched.init(self._spec, 1)
+        self._flush_fn, self._quantile_fn, self._merge_fn = self._jitted_ops(
+            self._spec
+        )
+        self._pending_vals: list = []
+        self._pending_weights: list = []
+        self._zero_count = 0.0
+        self._count = 0.0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    # -- core API ----------------------------------------------------------
+    def add(self, val: float, weight: float = 1.0) -> None:
+        if weight <= 0.0:
+            raise ValueError("weight must be positive")
+        self._pending_vals.append(val)
+        self._pending_weights.append(weight)
+        self._count += weight
+        self._sum += val * weight
+        if val < self._min:
+            self._min = val
+        if val > self._max:
+            self._max = val
+        if not (
+            val > self._mapping.min_possible or val < -self._mapping.min_possible
+        ):
+            self._zero_count += weight
+        if len(self._pending_vals) >= self._FLUSH_CHUNK:
+            self._flush()
+
+    def _flush(self) -> None:
+        while self._pending_vals:
+            chunk_v = self._pending_vals[: self._FLUSH_CHUNK]
+            chunk_w = self._pending_weights[: self._FLUSH_CHUNK]
+            del self._pending_vals[: self._FLUSH_CHUNK]
+            del self._pending_weights[: self._FLUSH_CHUNK]
+            values = np.zeros((1, self._FLUSH_CHUNK), np.float32)
+            weights = np.zeros((1, self._FLUSH_CHUNK), np.float32)
+            values[0, : len(chunk_v)] = chunk_v
+            weights[0, : len(chunk_w)] = chunk_w
+            self._state = self._flush_fn(self._state, values, weights)
+
+    def get_quantile_value(self, quantile: float) -> typing.Optional[float]:
+        if quantile < 0 or quantile > 1 or self._count == 0:
+            return None
+        self._flush()
+        out = float(self._quantile_fn(self._state, float(quantile))[0])
+        return out
+
+    def mergeable(self, other: "BaseDDSketch") -> bool:
+        """Jax-backed sketches need the full spec (gamma AND window) to
+        match; cross-backend merges need only gamma (the host bins are
+        re-keyed into this sketch's window, clamping at the edges)."""
+        if isinstance(other, JaxDDSketch):
+            return self._spec == other._spec
+        return self._mapping.gamma == other._mapping.gamma
+
+    def merge(self, sketch: "BaseDDSketch") -> None:
+        if not self.mergeable(sketch):
+            raise UnequalSketchParametersError(
+                "Cannot merge two DDSketches with different parameters"
+            )
+        if sketch.count == 0:
+            return
+        self._flush()
+        if isinstance(sketch, JaxDDSketch):
+            sketch._flush()
+            other_state = sketch._state
+        else:
+            # Cross-backend: pack the pure-Python sketch's bins into a
+            # 1-stream device state (mass outside the window clamps to the
+            # edge bins, like ingest-side collapse).
+            from sketches_tpu.batched import from_host_sketches
+
+            other_state = from_host_sketches(self._spec, [sketch])
+        self._state = self._merge_fn(self._state, other_state)
+        self._zero_count += sketch._zero_count
+        self._count += sketch._count
+        self._sum += sketch._sum
+        self._min = min(self._min, sketch._min)
+        self._max = max(self._max, sketch._max)
+
+    def copy(self) -> "JaxDDSketch":
+        import jax
+
+        self._flush()
+        new = JaxDDSketch(self._relative_accuracy, n_bins=self._spec.n_bins)
+        new._state = jax.tree.map(jax.numpy.copy, self._state)
+        new._zero_count = self._zero_count
+        new._count = self._count
+        new._sum = self._sum
+        new._min = self._min
+        new._max = self._max
+        return new
+
+    # -- accessors (BaseDDSketch properties read these fields) -------------
+    @property
+    def store(self):  # host materialization on demand
+        from sketches_tpu.batched import to_host_sketches
+
+        self._flush()
+        return to_host_sketches(self._spec, self._state)[0].store
+
+    @property
+    def negative_store(self):
+        from sketches_tpu.batched import to_host_sketches
+
+        self._flush()
+        return to_host_sketches(self._spec, self._state)[0].negative_store
+
+
 class DDSketch(BaseDDSketch):
     """Default preset: LogarithmicMapping + unbounded DenseStore (pos & neg).
 
-    Reference seam: ``ddsketch/ddsketch.py . DDSketch``.
+    Reference seam: ``ddsketch/ddsketch.py . DDSketch``.  Pass
+    ``backend='jax'`` to get the same API running on the device tier
+    (:class:`JaxDDSketch`); the default pure-Python backend doubles as the
+    oracle the device path is parity-tested against.
     """
 
-    def __init__(self, relative_accuracy: typing.Optional[float] = None):
+    def __new__(
+        cls,
+        relative_accuracy: typing.Optional[float] = None,
+        backend: str = "py",
+    ):
+        if backend == "jax" and cls is DDSketch:
+            return JaxDDSketch(relative_accuracy)
+        if backend not in ("py", "jax"):
+            raise ValueError(f"Unknown backend {backend!r}")
+        return super().__new__(cls)
+
+    def __init__(
+        self,
+        relative_accuracy: typing.Optional[float] = None,
+        backend: str = "py",
+    ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
         super().__init__(

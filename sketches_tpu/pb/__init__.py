@@ -1,0 +1,22 @@
+"""Protobuf serialization: the cross-language wire format + checkpoints.
+
+Reference seams: ``ddsketch/pb/ddsketch.proto``, ``ddsketch/pb/proto.py``
+(SURVEY.md section 2 rows 6-8).  Kept at the host edge: device state is
+``device_get`` into numpy first, then encoded (SURVEY.md section 3.5).
+"""
+
+from sketches_tpu.pb.proto import (
+    DDSketchProto,
+    KeyMappingProto,
+    StoreProto,
+    batched_from_proto,
+    batched_to_proto,
+)
+
+__all__ = [
+    "DDSketchProto",
+    "KeyMappingProto",
+    "StoreProto",
+    "batched_to_proto",
+    "batched_from_proto",
+]

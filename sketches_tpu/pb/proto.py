@@ -1,0 +1,150 @@
+"""Bridge between sketch objects and the DDSketch protobuf wire format.
+
+Parity target: reference ``ddsketch/pb/proto.py`` (``DDSketchProto``,
+``KeyMappingProto``, ``StoreProto`` -- SURVEY.md section 2 row 7): the
+interpolation enum maps to the mapping subclass, dense store runs map to
+``contiguousBinCounts`` + offset.  Additions for the device tier:
+``batched_to_proto`` / ``batched_from_proto`` serialize every stream of a
+``[n_streams, n_bins]`` batch (via the host-interop layer), so protobuf
+remains the cross-language edge while bulk checkpoints use
+``sketches_tpu.checkpoint``'s array format.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from sketches_tpu.ddsketch import BaseDDSketch, DDSketch
+from sketches_tpu.mapping import (
+    CubicallyInterpolatedMapping,
+    KeyMapping,
+    LinearlyInterpolatedMapping,
+    LogarithmicMapping,
+)
+from sketches_tpu.store import DenseStore, Store
+
+from sketches_tpu.pb import ddsketch_pb2 as pb
+
+__all__ = [
+    "KeyMappingProto",
+    "StoreProto",
+    "DDSketchProto",
+    "batched_to_proto",
+    "batched_from_proto",
+]
+
+_INTERPOLATION_TO_MAPPING = {
+    pb.IndexMapping.NONE: LogarithmicMapping,
+    pb.IndexMapping.LINEAR: LinearlyInterpolatedMapping,
+    pb.IndexMapping.CUBIC: CubicallyInterpolatedMapping,
+}
+_MAPPING_TO_INTERPOLATION = {
+    LogarithmicMapping: pb.IndexMapping.NONE,
+    LinearlyInterpolatedMapping: pb.IndexMapping.LINEAR,
+    CubicallyInterpolatedMapping: pb.IndexMapping.CUBIC,
+}
+
+
+class KeyMappingProto:
+    """mapping <-> IndexMapping{gamma, indexOffset, interpolation}."""
+
+    @classmethod
+    def to_proto(cls, mapping: KeyMapping) -> pb.IndexMapping:
+        try:
+            interpolation = _MAPPING_TO_INTERPOLATION[type(mapping)]
+        except KeyError:
+            raise ValueError(
+                f"No proto interpolation for mapping {type(mapping).__name__}"
+            ) from None
+        return pb.IndexMapping(
+            gamma=mapping.gamma,
+            indexOffset=mapping._offset,
+            interpolation=interpolation,
+        )
+
+    @classmethod
+    def from_proto(cls, proto: pb.IndexMapping) -> KeyMapping:
+        try:
+            mapping_cls = _INTERPOLATION_TO_MAPPING[proto.interpolation]
+        except KeyError:
+            raise ValueError(
+                f"Unsupported interpolation {proto.interpolation}"
+            ) from None
+        # Invert gamma = (1 + alpha) / (1 - alpha).
+        relative_accuracy = (proto.gamma - 1.0) / (proto.gamma + 1.0)
+        return mapping_cls(relative_accuracy, offset=proto.indexOffset)
+
+
+class StoreProto:
+    """store <-> Store{contiguousBinCounts, contiguousBinIndexOffset}.
+
+    Encodes the dense run; decodes both the dense run and the sparse
+    ``binCounts`` map (other languages may emit either).
+    """
+
+    @classmethod
+    def to_proto(cls, store: Store) -> pb.Store:
+        if not isinstance(store, DenseStore):
+            raise TypeError(f"Cannot serialize {type(store).__name__}")
+        return pb.Store(
+            contiguousBinCounts=store.bins,
+            contiguousBinIndexOffset=store.offset,
+        )
+
+    @classmethod
+    def merge_into(cls, proto: pb.Store, store: Store) -> None:
+        """Decode ``proto``'s mass into an existing store (additive)."""
+        for key, weight in proto.binCounts.items():
+            store.add(key, weight)
+        for i, weight in enumerate(proto.contiguousBinCounts):
+            if weight > 0:
+                store.add(i + proto.contiguousBinIndexOffset, weight)
+
+
+class DDSketchProto:
+    """sketch <-> DDSketch{mapping, positiveValues, negativeValues, zeroCount}.
+
+    Note (matching reference behavior): count/min/max/sum bookkeeping is not
+    part of the wire format; ``from_proto`` reconstructs ``count`` from bin
+    masses, while min/max/sum/avg are undefined on a decoded sketch.
+    """
+
+    @classmethod
+    def to_proto(cls, sketch: BaseDDSketch) -> pb.DDSketch:
+        return pb.DDSketch(
+            mapping=KeyMappingProto.to_proto(sketch.mapping),
+            positiveValues=StoreProto.to_proto(sketch.store),
+            negativeValues=StoreProto.to_proto(sketch.negative_store),
+            zeroCount=sketch.zero_count,
+        )
+
+    @classmethod
+    def from_proto(cls, proto: pb.DDSketch) -> DDSketch:
+        mapping = KeyMappingProto.from_proto(proto.mapping)
+        sketch = DDSketch(mapping.relative_accuracy)
+        sketch._mapping = mapping
+        sketch._relative_accuracy = mapping.relative_accuracy
+        StoreProto.merge_into(proto.positiveValues, sketch.store)
+        StoreProto.merge_into(proto.negativeValues, sketch.negative_store)
+        sketch._zero_count = proto.zeroCount
+        sketch._count = (
+            sketch.store.count + sketch.negative_store.count + proto.zeroCount
+        )
+        return sketch
+
+
+def batched_to_proto(spec, state) -> List[pb.DDSketch]:
+    """Serialize every stream of a device batch to wire-format messages."""
+    from sketches_tpu.batched import to_host_sketches
+
+    return [DDSketchProto.to_proto(sk) for sk in to_host_sketches(spec, state)]
+
+
+def batched_from_proto(spec, protos) -> "SketchState":  # noqa: F821
+    """Decode wire-format messages into one device batch (keys clamp into
+    the spec window, mass conserved)."""
+    from sketches_tpu.batched import from_host_sketches
+
+    return from_host_sketches(
+        spec, [DDSketchProto.from_proto(p) for p in protos]
+    )

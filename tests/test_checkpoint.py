@@ -1,0 +1,58 @@
+"""Checkpoint / resume round-trips (SURVEY.md section 5, checkpoint row)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.checkpoint import restore, restore_state, save, save_state
+from sketches_tpu.parallel import DistributedDDSketch
+from tests.datasets import Lognormal
+
+
+def test_state_roundtrip(tmp_path):
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=512, mapping_name="cubic_interpolated")
+    sk = BatchedDDSketch(n_streams=4, spec=spec)
+    vals = np.stack(
+        [np.asarray(list(Lognormal(300 + i)), np.float32)[:300] for i in range(4)]
+    )
+    sk.add(vals)
+    path = str(tmp_path / "ckpt.npz")
+    save(path, sk)
+    back = restore(path)
+    assert back.spec == spec
+    np.testing.assert_allclose(np.asarray(back.count), np.asarray(sk.count))
+    np.testing.assert_allclose(
+        np.asarray(back.get_quantile_values([0.5, 0.99])),
+        np.asarray(sk.get_quantile_values([0.5, 0.99])),
+    )
+    # resumed sketch keeps ingesting
+    back.add(np.ones((4, 8), np.float32))
+    assert float(back.count[0]) == 308.0
+
+
+def test_distributed_checkpoint_folds_partials(tmp_path):
+    spec = SketchSpec(relative_accuracy=0.05, n_bins=256)
+    dist = DistributedDDSketch(n_streams=2, spec=spec)
+    dist.add(np.abs(np.random.RandomState(0).normal(10, 2, (2, 64))).astype(np.float32))
+    path = str(tmp_path / "dist.npz")
+    save(path, dist)
+    back = restore(path)
+    np.testing.assert_allclose(np.asarray(back.count), np.asarray(dist.count))
+    np.testing.assert_allclose(
+        np.asarray(back.get_quantile_values([0.5])),
+        np.asarray(dist.get_quantile_values([0.5])),
+        rtol=1e-6,
+    )
+
+
+def test_save_state_preserves_collapse_counters(tmp_path):
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=64, key_offset=-32)
+    sk = BatchedDDSketch(n_streams=1, spec=spec)
+    sk.add(np.asarray([[1e30, 1.0]], np.float32))
+    path = str(tmp_path / "c.npz")
+    save_state(path, spec, sk.state)
+    spec2, state2 = restore_state(path)
+    assert spec2 == spec
+    assert float(state2.collapsed_high[0]) == 1.0
+    assert float(state2.min[0]) == 1.0

@@ -1,0 +1,140 @@
+"""backend='jax' seam: reference-shaped scalar API on the device tier.
+
+BASELINE.json north star: ``DDSketch(..., backend='jax')`` keeps the exact
+public API.  These tests run the reference test patterns (accuracy across
+datasets, merge equivalence, probes) against the jax-backed single sketch.
+"""
+
+import numpy as np
+import pytest
+
+from sketches_tpu import DDSketch, JaxDDSketch, UnequalSketchParametersError
+from tests.datasets import EPSILON, Integers, Normal, NumberLineBackward
+
+REL_ACC = 0.02
+QS = [0.0, 0.05, 0.25, 0.5, 0.75, 0.95, 1.0]
+
+
+def test_backend_selection():
+    assert isinstance(DDSketch(REL_ACC, backend="jax"), JaxDDSketch)
+    assert not isinstance(DDSketch(REL_ACC), JaxDDSketch)
+    with pytest.raises(ValueError, match="backend"):
+        DDSketch(REL_ACC, backend="torch")
+
+
+@pytest.mark.parametrize("dataset_cls", [Normal, Integers, NumberLineBackward])
+def test_accuracy_matches_contract(dataset_cls):
+    dataset = dataset_cls(6000)  # crosses the flush-chunk boundary
+    sk = DDSketch(REL_ACC, backend="jax")
+    for v in dataset:
+        sk.add(v)
+    for q in QS:
+        exact = dataset.quantile(q)
+        got = sk.get_quantile_value(q)
+        assert abs(got - exact) <= REL_ACC * abs(exact) + 1e-5, (q, exact, got)
+    assert sk.num_values == pytest.approx(len(dataset))
+    assert sk.sum == pytest.approx(dataset.sum, rel=1e-6)
+    assert sk.avg == pytest.approx(dataset.avg, rel=1e-6)
+
+
+def test_parity_with_python_backend():
+    dataset = Normal(3000)
+    jx, py = DDSketch(REL_ACC, backend="jax"), DDSketch(REL_ACC)
+    for v in dataset:
+        jx.add(v)
+        py.add(v)
+    for q in QS:
+        a, b = jx.get_quantile_value(q), py.get_quantile_value(q)
+        assert abs(a - b) <= 2 * REL_ACC * abs(b) + EPSILON
+
+
+def test_merge_and_probes():
+    dataset = Normal(2000)
+    s1, s2 = DDSketch(REL_ACC, backend="jax"), DDSketch(REL_ACC, backend="jax")
+    for i, v in enumerate(dataset):
+        (s1 if i % 2 else s2).add(v)
+    s1.merge(s2)
+    for q in QS:
+        exact = dataset.quantile(q)
+        assert abs(s1.get_quantile_value(q) - exact) <= REL_ACC * abs(exact) + 1e-5
+    # probes
+    empty = DDSketch(REL_ACC, backend="jax")
+    assert empty.get_quantile_value(0.5) is None
+    assert s1.get_quantile_value(1.5) is None
+    with pytest.raises(ValueError):
+        s1.add(1.0, weight=0.0)
+    other = DDSketch(0.2, backend="jax")
+    other.add(1.0)
+    with pytest.raises(UnequalSketchParametersError):
+        s1.merge(other)
+    # merging an empty sketch is a no-op
+    before = s1.get_quantile_value(0.5)
+    s1.merge(DDSketch(REL_ACC, backend="jax"))
+    assert s1.get_quantile_value(0.5) == before
+
+
+def test_zeros_negatives_and_weights():
+    sk = DDSketch(REL_ACC, backend="jax")
+    for v in [0.0, 0.0, -1.0, 1.0, 0.0]:
+        sk.add(v)
+    assert sk.count == 5
+    assert sk.zero_count == 3
+    assert sk.get_quantile_value(0.5) == 0.0
+    wk = DDSketch(REL_ACC, backend="jax")
+    wk.add(2.0, weight=3.0)
+    wk.add(10.0, weight=1.0)
+    assert wk.count == 4.0
+    assert abs(wk.get_quantile_value(0.5) - 2.0) <= REL_ACC * 2.0 + EPSILON
+
+
+def test_cross_backend_merge_both_directions():
+    data = list(Normal(1500))
+    py, jx = DDSketch(REL_ACC), DDSketch(REL_ACC, backend="jax")
+    for i, v in enumerate(data):
+        (py if i % 2 else jx).add(v)
+    # py <- jx
+    py2 = py.copy()
+    py2.merge(jx)
+    # jx <- py
+    jx.merge(py)
+    full = Normal(1500)
+    for q in [0.05, 0.5, 0.95]:
+        exact = full.quantile(q)
+        for sk in (py2, jx):
+            got = sk.get_quantile_value(q)
+            assert abs(got - exact) <= REL_ACC * abs(exact) + 1e-5, (q, got, exact)
+    assert py2.count == pytest.approx(1500)
+    assert jx.count == pytest.approx(1500)
+
+
+def test_jax_merge_rejects_different_windows():
+    a = JaxDDSketch(REL_ACC, n_bins=1024)
+    b = JaxDDSketch(REL_ACC)  # default 2048 bins
+    b.add(1.0)
+    assert not a.mergeable(b)
+    with pytest.raises(UnequalSketchParametersError):
+        a.merge(b)
+
+
+def test_jitted_ops_shared_across_instances():
+    a, b = JaxDDSketch(REL_ACC), JaxDDSketch(REL_ACC)
+    assert a._flush_fn is b._flush_fn
+    assert a.copy()._quantile_fn is a._quantile_fn
+
+
+def test_copy_is_deep():
+    sk = DDSketch(REL_ACC, backend="jax")
+    sk.add(1.0)
+    c = sk.copy()
+    c.add(100.0)
+    assert sk.count == 1
+    assert c.count == 2
+    assert sk.get_quantile_value(1.0) < 2.0
+
+
+def test_store_materialization():
+    sk = DDSketch(REL_ACC, backend="jax")
+    for v in [1.0, 2.0, -3.0]:
+        sk.add(v)
+    assert sk.store.count == pytest.approx(2.0)
+    assert sk.negative_store.count == pytest.approx(1.0)

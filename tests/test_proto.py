@@ -1,0 +1,109 @@
+"""Wire-format round-trips (reference tests: proto round-trip assertions in
+test_mapping.py / test_ddsketch.py -- SURVEY.md section 2 row 12)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sketches_tpu import (
+    CubicallyInterpolatedMapping,
+    DDSketch,
+    LinearlyInterpolatedMapping,
+    LogarithmicMapping,
+)
+from sketches_tpu.batched import SketchSpec, add, get_quantile_value, init
+from sketches_tpu.pb import (
+    DDSketchProto,
+    KeyMappingProto,
+    StoreProto,
+    batched_from_proto,
+    batched_to_proto,
+)
+from sketches_tpu.pb import ddsketch_pb2 as pb
+from tests.datasets import Normal
+
+
+@pytest.mark.parametrize(
+    "mapping_cls",
+    [LogarithmicMapping, LinearlyInterpolatedMapping, CubicallyInterpolatedMapping],
+)
+def test_mapping_roundtrip(mapping_cls):
+    mapping = mapping_cls(0.02, offset=3.0)
+    back = KeyMappingProto.from_proto(KeyMappingProto.to_proto(mapping))
+    assert type(back) is mapping_cls
+    assert back.gamma == pytest.approx(mapping.gamma, rel=1e-12)
+    assert back._offset == mapping._offset
+    for v in (0.01, 1.0, 12345.6):
+        assert back.key(v) == mapping.key(v)
+
+
+def test_sketch_roundtrip_quantiles():
+    sk = DDSketch(0.01)
+    data = list(Normal(2000))
+    for v in data + [0.0, 0.0, -5.0]:
+        sk.add(v)
+    blob = DDSketchProto.to_proto(sk).SerializeToString()
+    decoded = pb.DDSketch()
+    decoded.ParseFromString(blob)
+    back = DDSketchProto.from_proto(decoded)
+    assert back.count == pytest.approx(sk.count)
+    assert back.zero_count == pytest.approx(2.0)
+    for q in [0.01, 0.25, 0.5, 0.75, 0.99]:
+        assert back.get_quantile_value(q) == pytest.approx(
+            sk.get_quantile_value(q), rel=1e-9
+        )
+
+
+def test_sparse_bincounts_decode():
+    """Other languages may emit the sparse map form; decode must accept it."""
+    proto = pb.DDSketch(
+        mapping=pb.IndexMapping(gamma=LogarithmicMapping(0.01).gamma),
+        positiveValues=pb.Store(binCounts={10: 2.0, 25: 1.0}),
+        negativeValues=pb.Store(),
+        zeroCount=1.0,
+    )
+    sk = DDSketchProto.from_proto(proto)
+    assert sk.count == pytest.approx(4.0)
+    assert sk.store.count == pytest.approx(3.0)
+
+
+def test_unsupported_interpolation_raises():
+    proto = pb.IndexMapping(gamma=1.02, interpolation=pb.IndexMapping.QUADRATIC)
+    with pytest.raises(ValueError, match="interpolation"):
+        KeyMappingProto.from_proto(proto)
+
+
+def test_store_proto_rejects_unknown_store():
+    class Fake:
+        pass
+
+    with pytest.raises(TypeError):
+        StoreProto.to_proto(Fake())
+
+
+def test_batched_roundtrip_through_wire_format():
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=512)
+    vals = np.stack(
+        [np.asarray(list(Normal(400)), np.float32),
+         np.asarray(list(Normal(500))[:400], np.float32)]
+    )
+    state = add(spec, init(spec, 2), jnp.asarray(vals))
+    protos = batched_to_proto(spec, state)
+    assert len(protos) == 2
+    blobs = [p.SerializeToString() for p in protos]
+    decoded = []
+    for b in blobs:
+        m = pb.DDSketch()
+        m.ParseFromString(b)
+        decoded.append(m)
+    back = batched_from_proto(spec, decoded)
+    np.testing.assert_allclose(
+        np.asarray(back.bins_pos), np.asarray(state.bins_pos), rtol=1e-6
+    )
+    for q in (0.25, 0.5, 0.9):
+        np.testing.assert_allclose(
+            np.asarray(get_quantile_value(spec, back, q)),
+            np.asarray(get_quantile_value(spec, state, q)),
+            rtol=1e-5,
+        )
