@@ -86,6 +86,19 @@ def _bench_host_baseline(n: int = 200_000) -> float:
     return n / dt
 
 
+def _bench_native_host(n: int = 2_000_000) -> float:
+    """Native C++ host engine ingest rate (values/s); 0 if unavailable."""
+    from sketches_tpu.native import NativeDDSketch, available
+
+    if not available():
+        return 0.0
+    values = np.random.RandomState(0).lognormal(0.0, 2.0, n)
+    sk = NativeDDSketch(0.01)
+    t0 = time.perf_counter()
+    sk.add_batch(values)
+    return n / (time.perf_counter() - t0)
+
+
 def main():
     import jax
 
@@ -101,6 +114,7 @@ def main():
                 "vs_baseline": round(ingest_per_s / baseline, 2),
                 "baseline_host_add_per_s": round(baseline, 1),
                 "multi_quantile_query_s": round(query_s, 6),
+                "native_host_add_per_s": round(_bench_native_host(), 1),
                 "engine": engine,
                 "device": str(device),
             }

@@ -1,0 +1,204 @@
+// Native host-tier DDSketch engine.
+//
+// The reference implementation is pure Python (SURVEY.md section 2: native
+// components NONE), so this is new TPU-framework runtime code, not a port:
+// the host-side ingest/query engine for places the device tier cannot be
+// (data-loader threads, collector agents, pre-aggregation before device
+// upload).  Semantics deliberately mirror the *device* tier
+// (sketches_tpu/batched.py): a static bin window [key_offset,
+// key_offset + n_bins) with clamp-to-edge collapse and collapse-mass
+// counters, so a native sketch's bins can be copied verbatim into a batched
+// device state.
+//
+// Build: `make -C native` (plain C ABI; loaded via ctypes from
+// sketches_tpu/native.py -- no pybind11 dependency).
+
+#include <algorithm>
+#include <cmath>
+#include <cstddef>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+namespace {
+
+struct Sketch {
+  int n_bins;
+  int key_offset;
+  double gamma;
+  double multiplier;  // 1 / ln(gamma)
+  std::vector<double> pos;
+  std::vector<double> neg;
+  double zero_count = 0.0;
+  double count = 0.0;
+  double sum = 0.0;
+  double min = std::numeric_limits<double>::infinity();
+  double max = -std::numeric_limits<double>::infinity();
+  double collapsed_low = 0.0;
+  double collapsed_high = 0.0;
+};
+
+// Clamp in DOUBLE space before any int cast: log(inf) and huge finite
+// values overflow int, and an out-of-range double->int cast is UB (x86
+// yields INT_MIN, which would invert the collapse direction).
+inline int clamp_key(const Sketch& s, double dkey, bool* low, bool* high) {
+  const double lo = static_cast<double>(s.key_offset);
+  const double hi = static_cast<double>(s.key_offset + s.n_bins - 1);
+  if (dkey < lo) {
+    *low = true;
+    return s.key_offset;
+  }
+  if (dkey > hi) {
+    *high = true;
+    return s.key_offset + s.n_bins - 1;
+  }
+  return static_cast<int>(dkey);
+}
+
+inline void add_one(Sketch& s, double v, double w) {
+  if (w <= 0.0) return;  // inert padding, matching the device tier
+  if (v > 0.0) {
+    bool low = false, high = false;
+    int key = clamp_key(s, std::ceil(std::log(v) * s.multiplier), &low, &high);
+    s.pos[key - s.key_offset] += w;
+    if (low) s.collapsed_low += w;
+    if (high) s.collapsed_high += w;
+  } else if (v < 0.0) {
+    bool low = false, high = false;
+    int key = clamp_key(s, std::ceil(std::log(-v) * s.multiplier), &low, &high);
+    s.neg[key - s.key_offset] += w;
+    if (low) s.collapsed_low += w;
+    if (high) s.collapsed_high += w;
+  } else if (v == 0.0 || v != v) {  // zero or NaN -> zero bucket
+    s.zero_count += w;
+  }
+  s.count += w;
+  s.sum += v * w;
+  if (v < s.min) s.min = v;
+  if (v > s.max) s.max = v;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sketch_create(double relative_accuracy, int n_bins, int key_offset) {
+  if (relative_accuracy <= 0.0 || relative_accuracy >= 1.0 || n_bins < 2) {
+    return nullptr;
+  }
+  auto* s = new Sketch();
+  s->n_bins = n_bins;
+  s->key_offset = key_offset;
+  const double mantissa =
+      2.0 * relative_accuracy / (1.0 - relative_accuracy);
+  s->gamma = 1.0 + mantissa;
+  s->multiplier = 1.0 / std::log1p(mantissa);
+  s->pos.assign(n_bins, 0.0);
+  s->neg.assign(n_bins, 0.0);
+  return s;
+}
+
+void sketch_destroy(void* handle) { delete static_cast<Sketch*>(handle); }
+
+void sketch_add(void* handle, double value, double weight) {
+  add_one(*static_cast<Sketch*>(handle), value, weight);
+}
+
+void sketch_add_batch(void* handle, const double* values,
+                      const double* weights, size_t n) {
+  Sketch& s = *static_cast<Sketch*>(handle);
+  if (weights == nullptr) {
+    for (size_t i = 0; i < n; ++i) add_one(s, values[i], 1.0);
+  } else {
+    for (size_t i = 0; i < n; ++i) add_one(s, values[i], weights[i]);
+  }
+}
+
+// Value at quantile q, or NaN for invalid q / empty sketch.  Mirrors
+// BaseDDSketch.get_quantile_value (ddsketch.py) on the static window.
+double sketch_quantile(void* handle, double q) {
+  const Sketch& s = *static_cast<Sketch*>(handle);
+  if (q < 0.0 || q > 1.0 || s.count == 0.0) {
+    return std::numeric_limits<double>::quiet_NaN();
+  }
+  double neg_count = 0.0;
+  for (double b : s.neg) neg_count += b;
+  const double rank = q * (s.count - 1.0);
+  const double rep = 2.0 / (1.0 + s.gamma);
+  if (rank < neg_count) {
+    // lower=False walk from the top of the negative store.
+    const double target = neg_count - 1.0 - rank;
+    double running = 0.0;
+    for (int i = 0; i < s.n_bins; ++i) {
+      running += s.neg[i];
+      if (running >= target + 1.0) {
+        return -std::exp((i + s.key_offset) / s.multiplier) * rep;
+      }
+    }
+    return -std::exp((s.n_bins - 1 + s.key_offset) / s.multiplier) * rep;
+  }
+  if (rank < neg_count + s.zero_count) return 0.0;
+  const double target = rank - neg_count - s.zero_count;
+  double running = 0.0;
+  for (int i = 0; i < s.n_bins; ++i) {
+    running += s.pos[i];
+    if (running > target) {
+      return std::exp((i + s.key_offset) / s.multiplier) * rep;
+    }
+  }
+  return std::exp((s.n_bins - 1 + s.key_offset) / s.multiplier) * rep;
+}
+
+// Fold `other` into `handle`; both must share (gamma, n_bins, key_offset) --
+// the caller checks, we only verify shape to stay memory-safe.
+int sketch_merge(void* handle, const void* other) {
+  Sketch& a = *static_cast<Sketch*>(handle);
+  const Sketch& b = *static_cast<const Sketch*>(other);
+  if (a.n_bins != b.n_bins || a.key_offset != b.key_offset) return -1;
+  for (int i = 0; i < a.n_bins; ++i) {
+    a.pos[i] += b.pos[i];
+    a.neg[i] += b.neg[i];
+  }
+  a.zero_count += b.zero_count;
+  a.count += b.count;
+  a.sum += b.sum;
+  a.min = std::min(a.min, b.min);
+  a.max = std::max(a.max, b.max);
+  a.collapsed_low += b.collapsed_low;
+  a.collapsed_high += b.collapsed_high;
+  return 0;
+}
+
+// Counter accessors (order: zero, count, sum, min, max, clow, chigh).
+void sketch_counters(void* handle, double* out7) {
+  const Sketch& s = *static_cast<Sketch*>(handle);
+  out7[0] = s.zero_count;
+  out7[1] = s.count;
+  out7[2] = s.sum;
+  out7[3] = s.min;
+  out7[4] = s.max;
+  out7[5] = s.collapsed_low;
+  out7[6] = s.collapsed_high;
+}
+
+void sketch_bins(void* handle, double* out_pos, double* out_neg) {
+  const Sketch& s = *static_cast<Sketch*>(handle);
+  std::copy(s.pos.begin(), s.pos.end(), out_pos);
+  std::copy(s.neg.begin(), s.neg.end(), out_neg);
+}
+
+void sketch_load_bins(void* handle, const double* pos, const double* neg,
+                      const double* counters7) {
+  Sketch& s = *static_cast<Sketch*>(handle);
+  std::copy(pos, pos + s.n_bins, s.pos.begin());
+  std::copy(neg, neg + s.n_bins, s.neg.begin());
+  s.zero_count = counters7[0];
+  s.count = counters7[1];
+  s.sum = counters7[2];
+  s.min = counters7[3];
+  s.max = counters7[4];
+  s.collapsed_low = counters7[5];
+  s.collapsed_high = counters7[6];
+}
+
+}  // extern "C"

@@ -40,8 +40,13 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
             "dtype": jnp.dtype(spec.dtype).name,
         }
     )
-    np.savez_compressed(path, __spec__=np.frombuffer(spec_json.encode(), np.uint8),
-                        **arrays)
+    # Write through a file object: np.savez on a bare path silently appends
+    # '.npz', which would break the save()/restore() round-trip for any
+    # other suffix.
+    with open(path, "wb") as f:
+        np.savez_compressed(
+            f, __spec__=np.frombuffer(spec_json.encode(), np.uint8), **arrays
+        )
 
 
 def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:

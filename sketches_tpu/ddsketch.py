@@ -261,6 +261,7 @@ class JaxDDSketch(BaseDDSketch):
         )
         self._pending_vals: list = []
         self._pending_weights: list = []
+        self._host_cache: typing.Optional[BaseDDSketch] = None
         self._zero_count = 0.0
         self._count = 0.0
         self._sum = 0.0
@@ -273,6 +274,7 @@ class JaxDDSketch(BaseDDSketch):
             raise ValueError("weight must be positive")
         self._pending_vals.append(val)
         self._pending_weights.append(weight)
+        self._host_cache = None
         self._count += weight
         self._sum += val * weight
         if val < self._min:
@@ -307,11 +309,13 @@ class JaxDDSketch(BaseDDSketch):
 
     def mergeable(self, other: "BaseDDSketch") -> bool:
         """Jax-backed sketches need the full spec (gamma AND window) to
-        match; cross-backend merges need only gamma (the host bins are
-        re-keyed into this sketch's window, clamping at the edges)."""
+        match; cross-backend merges need the identical mapping (type, gamma,
+        offset) -- same-gamma alone is not enough, since all mapping types
+        share the gamma formula while keying differently.  The host bins are
+        then packed into this sketch's window, clamping at the edges."""
         if isinstance(other, JaxDDSketch):
             return self._spec == other._spec
-        return self._mapping.gamma == other._mapping.gamma
+        return self._mapping == other._mapping
 
     def merge(self, sketch: "BaseDDSketch") -> None:
         if not self.mergeable(sketch):
@@ -332,6 +336,7 @@ class JaxDDSketch(BaseDDSketch):
 
             other_state = from_host_sketches(self._spec, [sketch])
         self._state = self._merge_fn(self._state, other_state)
+        self._host_cache = None
         self._zero_count += sketch._zero_count
         self._count += sketch._count
         self._sum += sketch._sum
@@ -352,19 +357,24 @@ class JaxDDSketch(BaseDDSketch):
         return new
 
     # -- accessors (BaseDDSketch properties read these fields) -------------
-    @property
-    def store(self):  # host materialization on demand
-        from sketches_tpu.batched import to_host_sketches
+    def _host_view(self) -> "BaseDDSketch":
+        """Host materialization of the device bins, cached until the next
+        mutation so back-to-back store/negative_store reads pay for one
+        device transfer, not two."""
+        if self._host_cache is None:
+            from sketches_tpu.batched import to_host_sketches
 
-        self._flush()
-        return to_host_sketches(self._spec, self._state)[0].store
+            self._flush()
+            self._host_cache = to_host_sketches(self._spec, self._state)[0]
+        return self._host_cache
+
+    @property
+    def store(self):
+        return self._host_view().store
 
     @property
     def negative_store(self):
-        from sketches_tpu.batched import to_host_sketches
-
-        self._flush()
-        return to_host_sketches(self._spec, self._state)[0].negative_store
+        return self._host_view().negative_store
 
 
 class DDSketch(BaseDDSketch):

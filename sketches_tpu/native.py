@@ -1,0 +1,257 @@
+"""ctypes bindings for the native host-tier engine (native/ddsketch_host.cpp).
+
+The reference has no native code (SURVEY.md section 2); this engine exists
+for the host side of the TPU framework -- data-loader threads and collector
+processes that pre-aggregate before device upload.  It shares the device
+tier's static-window semantics, so ``to_state`` lifts a native sketch
+directly into a ``[1, n_bins]`` batched state (and ``from_state`` back).
+
+The shared library builds on demand with ``make -C native`` (plain C ABI,
+no pybind11).  ``available()`` reports whether a toolchain/library exists;
+everything degrades gracefully to the pure-Python tier when it does not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import math
+import os
+import subprocess
+import threading
+import typing
+
+import numpy as np
+
+__all__ = ["available", "NativeDDSketch"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libddsketch_host.so")
+_lock = threading.Lock()
+_lib: typing.Optional[ctypes.CDLL] = None
+_build_error: typing.Optional[str] = None
+
+
+def _load() -> typing.Optional[ctypes.CDLL]:
+    """Build (once, if needed) and load the shared library."""
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True,
+                    capture_output=True,
+                    text=True,
+                )
+            except (OSError, subprocess.CalledProcessError) as e:
+                _build_error = getattr(e, "stderr", None) or str(e)
+                return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.sketch_create.restype = ctypes.c_void_p
+        lib.sketch_create.argtypes = [ctypes.c_double, ctypes.c_int, ctypes.c_int]
+        lib.sketch_destroy.argtypes = [ctypes.c_void_p]
+        lib.sketch_add.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
+        lib.sketch_add_batch.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_size_t,
+        ]
+        lib.sketch_quantile.restype = ctypes.c_double
+        lib.sketch_quantile.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.sketch_merge.restype = ctypes.c_int
+        lib.sketch_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.sketch_counters.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.sketch_bins.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        lib.sketch_load_bins.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True iff the native engine can be built/loaded on this machine."""
+    return _load() is not None
+
+
+def _dptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+class NativeDDSketch:
+    """Reference-shaped single sketch backed by the C++ engine.
+
+    Same static-window semantics as the device tier: keys clamp into
+    ``[key_offset, key_offset + n_bins)``; ``add_batch`` is the fast path.
+    """
+
+    def __init__(
+        self,
+        relative_accuracy: float = 0.01,
+        n_bins: int = 2048,
+        key_offset: typing.Optional[int] = None,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native engine unavailable: {_build_error or 'no toolchain'}"
+            )
+        if key_offset is None:
+            key_offset = -(n_bins // 2)
+        self._lib = lib
+        self._handle = lib.sketch_create(relative_accuracy, n_bins, key_offset)
+        if not self._handle:
+            raise ValueError("invalid sketch parameters")
+        self.relative_accuracy = relative_accuracy
+        self.n_bins = n_bins
+        self.key_offset = key_offset
+        mantissa = 2.0 * relative_accuracy / (1.0 - relative_accuracy)
+        self.gamma = 1.0 + mantissa
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.sketch_destroy(handle)
+            self._handle = None
+
+    # -- core API ----------------------------------------------------------
+    def add(self, val: float, weight: float = 1.0) -> None:
+        if weight <= 0.0:
+            raise ValueError("weight must be positive")
+        self._lib.sketch_add(self._handle, float(val), float(weight))
+
+    def add_batch(
+        self,
+        values: np.ndarray,
+        weights: typing.Optional[np.ndarray] = None,
+    ) -> "NativeDDSketch":
+        values = np.ascontiguousarray(values, dtype=np.float64).ravel()
+        wptr = None
+        if weights is not None:
+            weights = np.ascontiguousarray(weights, dtype=np.float64).ravel()
+            if weights.shape != values.shape:
+                raise ValueError("weights shape must match values")
+            wptr = _dptr(weights)
+        self._lib.sketch_add_batch(self._handle, _dptr(values), wptr, values.size)
+        return self
+
+    def get_quantile_value(self, quantile: float) -> typing.Optional[float]:
+        out = self._lib.sketch_quantile(self._handle, float(quantile))
+        return None if math.isnan(out) else out
+
+    def merge(self, other: "NativeDDSketch") -> None:
+        from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+        if (
+            self.gamma != other.gamma
+            or self.n_bins != other.n_bins
+            or self.key_offset != other.key_offset
+        ):
+            raise UnequalSketchParametersError(
+                "Cannot merge native sketches with different parameters"
+            )
+        if self._lib.sketch_merge(self._handle, other._handle) != 0:
+            raise UnequalSketchParametersError("Incompatible native sketches")
+
+    def mergeable(self, other: "NativeDDSketch") -> bool:
+        return (
+            self.gamma == other.gamma
+            and self.n_bins == other.n_bins
+            and self.key_offset == other.key_offset
+        )
+
+    # -- accessors ---------------------------------------------------------
+    def _counters(self) -> np.ndarray:
+        out = np.empty(7, np.float64)
+        self._lib.sketch_counters(self._handle, _dptr(out))
+        return out
+
+    @property
+    def zero_count(self) -> float:
+        return float(self._counters()[0])
+
+    @property
+    def count(self) -> float:
+        return float(self._counters()[1])
+
+    num_values = count
+
+    @property
+    def sum(self) -> float:  # noqa: A003 - reference API name
+        return float(self._counters()[2])
+
+    @property
+    def avg(self) -> float:
+        c = self._counters()
+        return float(c[2] / c[1])
+
+    @property
+    def collapsed_low(self) -> float:
+        return float(self._counters()[5])
+
+    @property
+    def collapsed_high(self) -> float:
+        return float(self._counters()[6])
+
+    def bins(self) -> typing.Tuple[np.ndarray, np.ndarray]:
+        pos = np.empty(self.n_bins, np.float64)
+        neg = np.empty(self.n_bins, np.float64)
+        self._lib.sketch_bins(self._handle, _dptr(pos), _dptr(neg))
+        return pos, neg
+
+    # -- device interop ----------------------------------------------------
+    def to_state(self):
+        """Lift into a 1-stream batched device state (same window layout)."""
+        import jax.numpy as jnp
+
+        from sketches_tpu.batched import SketchState
+
+        pos, neg = self.bins()
+        c = self._counters()
+        as_row = lambda x: jnp.asarray(x, jnp.float32)[None]
+        return SketchState(
+            bins_pos=as_row(pos),
+            bins_neg=as_row(neg),
+            zero_count=jnp.asarray([c[0]], jnp.float32),
+            count=jnp.asarray([c[1]], jnp.float32),
+            sum=jnp.asarray([c[2]], jnp.float32),
+            min=jnp.asarray([c[3]], jnp.float32),
+            max=jnp.asarray([c[4]], jnp.float32),
+            collapsed_low=jnp.asarray([c[5]], jnp.float32),
+            collapsed_high=jnp.asarray([c[6]], jnp.float32),
+        )
+
+    @classmethod
+    def from_state(cls, spec, state, stream: int = 0) -> "NativeDDSketch":
+        """Extract one stream of a batched state into a native sketch."""
+        import jax
+
+        if spec.mapping_name != "logarithmic":
+            raise ValueError("native engine supports the logarithmic mapping")
+        sk = cls(spec.relative_accuracy, spec.n_bins, spec.key_offset)
+        host = jax.device_get(state)
+        counters = np.asarray(
+            [
+                host.zero_count[stream], host.count[stream], host.sum[stream],
+                host.min[stream], host.max[stream],
+                host.collapsed_low[stream], host.collapsed_high[stream],
+            ],
+            np.float64,
+        )
+        pos = np.ascontiguousarray(host.bins_pos[stream], np.float64)
+        neg = np.ascontiguousarray(host.bins_neg[stream], np.float64)
+        sk._lib.sketch_load_bins(sk._handle, _dptr(pos), _dptr(neg), _dptr(counters))
+        return sk

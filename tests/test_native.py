@@ -1,0 +1,117 @@
+"""Native C++ host engine: parity with the Python oracle and device tier."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sketches_tpu import DDSketch
+from sketches_tpu.batched import SketchSpec, add, get_quantile_value, init
+from sketches_tpu.native import NativeDDSketch, available
+from tests.datasets import ALL_DATASETS, Normal
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable"
+)
+
+REL_ACC = 0.02
+
+
+@pytest.mark.parametrize("dataset_cls", ALL_DATASETS)
+def test_accuracy_contract(dataset_cls):
+    dataset = dataset_cls(2000)
+    sk = NativeDDSketch(REL_ACC)
+    sk.add_batch(np.asarray(list(dataset)))
+    for q in [0.0, 0.25, 0.5, 0.75, 0.99, 1.0]:
+        exact = dataset.quantile(q)
+        got = sk.get_quantile_value(q)
+        assert abs(got - exact) <= REL_ACC * abs(exact) + 1e-9, (
+            dataset_cls.__name__, q, got, exact,
+        )
+    assert sk.count == pytest.approx(len(dataset))
+    assert sk.sum == pytest.approx(dataset.sum, rel=1e-9)
+
+
+def test_parity_with_python_oracle():
+    data = list(Normal(3000))
+    native, py = NativeDDSketch(REL_ACC), DDSketch(REL_ACC)
+    native.add_batch(np.asarray(data))
+    for v in data:
+        py.add(v)
+    for q in [0.05, 0.5, 0.95]:
+        a, b = native.get_quantile_value(q), py.get_quantile_value(q)
+        assert abs(a - b) <= 2 * REL_ACC * abs(b) + 1e-9
+
+
+def test_scalar_add_weighted_and_probes():
+    sk = NativeDDSketch(REL_ACC)
+    sk.add(2.0, weight=3.0)
+    sk.add(10.0)
+    sk.add(0.0)
+    sk.add(-4.0)
+    assert sk.count == 6.0
+    assert sk.zero_count == 1.0
+    assert abs(sk.get_quantile_value(0.5) - 2.0) <= REL_ACC * 2.0 + 1e-9
+    assert NativeDDSketch(REL_ACC).get_quantile_value(0.5) is None
+    assert sk.get_quantile_value(1.5) is None
+    with pytest.raises(ValueError):
+        sk.add(1.0, weight=0.0)
+
+
+def test_merge_and_mergeable():
+    from sketches_tpu import UnequalSketchParametersError
+
+    data = np.asarray(list(Normal(2000)))
+    a, b = NativeDDSketch(REL_ACC), NativeDDSketch(REL_ACC)
+    a.add_batch(data[::2])
+    b.add_batch(data[1::2])
+    a.merge(b)
+    full = NativeDDSketch(REL_ACC)
+    full.add_batch(data)
+    for q in [0.1, 0.5, 0.9]:
+        assert a.get_quantile_value(q) == pytest.approx(
+            full.get_quantile_value(q)
+        )
+    other = NativeDDSketch(0.1)
+    assert not a.mergeable(other)
+    with pytest.raises(UnequalSketchParametersError):
+        a.merge(other)
+
+
+def test_collapse_counters_and_mass_conservation():
+    sk = NativeDDSketch(0.01, n_bins=64, key_offset=-32)
+    sk.add_batch(np.asarray([1e30, 1e-30, 1.0, 0.0, -1e30]))
+    assert sk.collapsed_high == 2.0
+    assert sk.collapsed_low == 1.0
+    pos, neg = sk.bins()
+    assert pos.sum() + neg.sum() + sk.zero_count == pytest.approx(sk.count)
+
+
+def test_device_state_roundtrip():
+    spec = SketchSpec(relative_accuracy=REL_ACC, n_bins=2048)
+    data = np.asarray(list(Normal(1000)), np.float32)
+    native = NativeDDSketch(REL_ACC, n_bins=spec.n_bins, key_offset=spec.key_offset)
+    native.add_batch(data)
+    state = native.to_state()
+    dev = add(spec, init(spec, 1), jnp.asarray(data)[None])
+    np.testing.assert_allclose(
+        np.asarray(state.bins_pos), np.asarray(dev.bins_pos), rtol=1e-6
+    )
+    for q in (0.25, 0.5, 0.9):
+        np.testing.assert_allclose(
+            float(get_quantile_value(spec, state, q)[0]),
+            float(get_quantile_value(spec, dev, q)[0]),
+            rtol=1e-5,
+        )
+    back = NativeDDSketch.from_state(spec, state)
+    assert back.count == pytest.approx(native.count)
+    assert back.get_quantile_value(0.5) == pytest.approx(
+        native.get_quantile_value(0.5), rel=1e-5
+    )
+
+
+def test_nan_goes_to_zero_bucket():
+    sk = NativeDDSketch(REL_ACC)
+    sk.add_batch(np.asarray([1.0, np.nan, 5.0]))
+    assert sk.count == 3.0
+    assert sk.zero_count == 1.0
