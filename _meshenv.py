@@ -1,0 +1,22 @@
+"""Virtual CPU mesh environment override — single source of truth.
+
+The host environment pins ``JAX_PLATFORMS`` to the single real TPU tunnel,
+so anything that needs an n-device mesh without n real chips (tests,
+``__graft_entry__.dryrun_multichip``) must force the virtual CPU platform.
+This module is deliberately jax-free so it can be imported before jax.
+"""
+
+import re
+
+_FORCE_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def cpu_mesh_env(n_devices, env):
+    """Return a copy of ``env`` forcing an ``n_devices`` virtual CPU platform."""
+    out = dict(env)
+    out["JAX_PLATFORMS"] = "cpu"
+    flags = _FORCE_COUNT_RE.sub("", out.get("XLA_FLAGS", "")).strip()
+    out["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n_devices)}"
+    ).strip()
+    return out

@@ -7,16 +7,17 @@ import time in conftest.
 """
 
 import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Force, not setdefault: the host environment pins JAX_PLATFORMS to the real
 # TPU tunnel (and a sitecustomize hook imports jax at interpreter startup),
 # so both the env var and the runtime config must be overridden here.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# _meshenv is the shared source of truth with __graft_entry__.dryrun_multichip.
+from _meshenv import cpu_mesh_env  # noqa: E402  (jax-free by design)
+
+os.environ.update(cpu_mesh_env(8, os.environ))
 
 import jax  # noqa: E402  (after env setup by design)
 
