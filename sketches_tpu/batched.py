@@ -82,6 +82,12 @@ class SketchSpec:
     # window on key(1.0) = 0, covering values in roughly
     # [gamma**key_offset, gamma**(key_offset + n_bins)).
     key_offset: Optional[int] = None
+    # Accumulator dtype for bins and counters.  f32 mass accumulation is
+    # exact only up to 2**24 (~16.7M) per bin/counter: beyond that, unit
+    # adds round away (x + 1 == x) and quantiles bias silently.  For larger
+    # per-stream counts use jnp.float64 (requires jax_enable_x64; emulated
+    # and slow on TPU) or shard the stream and merge.  The exact-regime
+    # bound is tested in tests/test_batched.py.
     dtype: jnp.dtype = jnp.float32
 
     def __post_init__(self):
@@ -91,6 +97,10 @@ class SketchSpec:
             raise ValueError("n_bins must be >= 2")
         if self.key_offset is None:
             object.__setattr__(self, "key_offset", -(self.n_bins // 2))
+        # Windows wider than the f32-representable value range are fine:
+        # bins beyond what f32 ingest can reach stay empty, and
+        # ``KeyMapping.value_array`` saturates its decode to the positive
+        # finite f32 range, so quantiles remain finite for any window.
 
     @functools.cached_property
     def mapping(self) -> KeyMapping:

@@ -169,12 +169,12 @@ class BaseDDSketch:
             )
         if sketch._count == 0:
             return
-        if self._count == 0:
-            self._copy(sketch)
-            return
 
         # Public accessors, not _store: a jax-backed operand materializes its
-        # device bins as host stores through these properties.
+        # device bins as host stores through these properties.  An empty self
+        # takes the same path (Store.merge re-bins through self's own store
+        # type), so merging never swaps in the operand's store class or its
+        # collapse semantics.
         self._store.merge(sketch.store)
         self._negative_store.merge(sketch.negative_store)
         self._zero_count += sketch._zero_count
@@ -187,8 +187,16 @@ class BaseDDSketch:
             self._max = sketch._max
 
     def mergeable(self, other: "BaseDDSketch") -> bool:
-        """Two sketches are mergeable iff their mappings share gamma."""
-        return self._mapping.gamma == other._mapping.gamma
+        """Two sketches are mergeable iff their mappings are identical.
+
+        Deliberately stricter than the reference's same-gamma check: all
+        three mapping types share the gamma formula at equal alpha but key
+        values differently, so same-gamma-different-type merges would add
+        incompatible bin indices and silently corrupt quantiles.  Identity =
+        same type, gamma, and offset (``KeyMapping.__eq__``), which also
+        keeps the check symmetric with ``JaxDDSketch.mergeable``.
+        """
+        return self._mapping == other._mapping
 
     def _copy(self, sketch: "BaseDDSketch") -> None:
         self._store = sketch.store.copy()
@@ -215,7 +223,8 @@ class JaxDDSketch(BaseDDSketch):
     chunks (fixed so one jit compilation serves every flush); queries and
     merges flush first.  Scalar bookkeeping (count/sum/min/max) stays in
     host float64 -- strictly more precise than the reference's -- while bin
-    mass lives on device.
+    mass lives on device in float32, which accumulates exactly only up to
+    2**24 (~16.7M) mass per bin (see ``SketchSpec.dtype``).
 
     Deliberately *not* a subclass of ``DDSketch``: ``DDSketch.__new__``
     returns one of these when asked for the jax backend, and Python then
@@ -281,9 +290,12 @@ class JaxDDSketch(BaseDDSketch):
             self._min = val
         if val > self._max:
             self._max = val
-        if not (
-            val > self._mapping.min_possible or val < -self._mapping.min_possible
-        ):
+        # Classify zero with the *device's* semantics -- sign test after the
+        # f32 cast -- not the host mapping's f64 min_possible: values that
+        # underflow to 0.0 in f32 land in the device zero path, and the host
+        # counter must agree or cross-backend merges drop that mass.
+        vf = float(np.float32(val))
+        if not (vf > 0.0 or vf < 0.0):  # zero, f32-underflow, or NaN
             self._zero_count += weight
         if len(self._pending_vals) >= self._FLUSH_CHUNK:
             self._flush()

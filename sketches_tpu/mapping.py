@@ -38,6 +38,9 @@ import sys
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
+
+_F32_FINFO = np.finfo(np.float32)
 
 __all__ = [
     "KeyMapping",
@@ -100,10 +103,28 @@ class KeyMapping:
             round(self._offset)
         )
 
-    def value_array(self, key):
-        """Elementwise ``value`` for an int array of keys -> float values."""
-        k = key.astype(jnp.float32) - jnp.float32(self._offset)
+    def _scaled_pow_gamma_array(self, k):
+        """pow_gamma(k) * the bucket-midpoint scale 2/(1+gamma); subclasses
+        may fuse the scale to keep f32 intermediates from overflowing."""
         return self._pow_gamma_array(k) * jnp.float32(2.0 / (1.0 + self.gamma))
+
+    def value_array(self, key):
+        """Elementwise ``value`` for an int array of keys -> f32 values.
+
+        *Saturating*: results clamp to the positive finite f32 range.  A key
+        window may contain buckets whose true representative is outside f32
+        (wide windows; the very top representable bucket, whose midpoint can
+        round past f32 max) -- those decode to the nearest positive finite
+        f32 instead of inf/0, keeping device quantiles finite everywhere the
+        f64 host tier's are (ADVICE round 1).
+        """
+        k = key.astype(jnp.float32) - jnp.float32(self._offset)
+        fin = _F32_FINFO
+        return jnp.clip(
+            self._scaled_pow_gamma_array(k),
+            jnp.float32(fin.tiny),
+            jnp.float32(fin.max),
+        )
 
     # -- equality / identity ----------------------------------------------
     def __eq__(self, other: object) -> bool:
@@ -140,6 +161,14 @@ class LogarithmicMapping(KeyMapping):
 
     def _pow_gamma_array(self, value):
         return jnp.exp(value / jnp.float32(self._multiplier))
+
+    def _scaled_pow_gamma_array(self, k):
+        # Fuse the midpoint scale into the exponent: exp(k/m) alone can
+        # overflow f32 for keys whose *scaled* value is still representable.
+        return jnp.exp(
+            k / jnp.float32(self._multiplier)
+            + jnp.float32(math.log(2.0 / (1.0 + self.gamma)))
+        )
 
 
 def _frexp_array(value):

@@ -158,7 +158,13 @@ class DenseStore(Store):
             raise TypeError(f"Cannot merge {type(self).__name__} with {type(store).__name__}")
         if store.is_empty:
             return
-        if self.is_empty:
+        # The fast path (adopt the operand's bins wholesale) is only sound
+        # when both stores share collapse semantics; otherwise an unbounded
+        # store could inherit collapsed state, or a bounded one could exceed
+        # its bin_limit.  Mixed types re-bin through add_raw, which clamps.
+        if self.is_empty and type(store) is type(self) and (
+            getattr(self, "bin_limit", None) == getattr(store, "bin_limit", None)
+        ):
             self._copy_from(store)
             return
         self._extend_range(int(store.min_key), int(store.max_key))

@@ -138,3 +138,66 @@ def test_store_materialization():
         sk.add(v)
     assert sk.store.count == pytest.approx(2.0)
     assert sk.negative_store.count == pytest.approx(1.0)
+
+
+def test_f32_underflow_classified_zero_on_both_sides():
+    # ADVICE round 1: the host counter used the f64 mapping's min_possible
+    # while the device classifies sign after the f32 cast, so values that
+    # underflow to 0.0 in f32 (e.g. 1e-100) were zero on device but
+    # positive on host, and cross-backend merges dropped that mass.
+    jx = DDSketch(REL_ACC, backend="jax")
+    jx.add(1e-100)  # underflows to +0.0 in f32
+    jx.add(5.0)
+    assert jx.zero_count == 1.0
+
+    py = DDSketch(REL_ACC)
+    py.merge(jx)
+    binned = py.zero_count + py.store.count + py.negative_store.count
+    assert py.count == 2.0
+    assert binned == pytest.approx(py.count)
+
+
+def test_merge_into_empty_py_sketch_keeps_unbounded_store():
+    # ADVICE round 1: merging a jax-backed sketch into an *empty* unbounded
+    # DDSketch installed the host-view's collapsing stores as self._store,
+    # silently converting the sketch to collapsing semantics.
+    from sketches_tpu.store import DenseStore
+
+    jx = DDSketch(REL_ACC, backend="jax")
+    for v in Normal(500):
+        jx.add(v)
+    py = DDSketch(REL_ACC)
+    py.merge(jx)
+    assert type(py.store) is DenseStore
+    assert type(py.negative_store) is DenseStore
+    assert py.count == jx.count
+    for q in QS:
+        a, b = py.get_quantile_value(q), jx.get_quantile_value(q)
+        assert abs(a - b) <= 2 * REL_ACC * abs(b) + EPSILON
+
+
+def test_merge_rejects_same_gamma_different_mapping():
+    # ADVICE round 1: gamma alone is not mergeability -- all mapping types
+    # share the gamma formula at equal alpha but key values differently.
+    from sketches_tpu.ddsketch import BaseDDSketch
+    from sketches_tpu.mapping import CubicallyInterpolatedMapping
+    from sketches_tpu.store import DenseStore
+
+    cubic = BaseDDSketch(
+        mapping=CubicallyInterpolatedMapping(REL_ACC),
+        store=DenseStore(),
+        negative_store=DenseStore(),
+    )
+    log_py = DDSketch(REL_ACC)
+    log_jx = DDSketch(REL_ACC, backend="jax")
+    for sk in (cubic, log_py, log_jx):
+        sk.add(1.0)
+    assert cubic.mapping.gamma == log_py.mapping.gamma
+    with pytest.raises(UnequalSketchParametersError):
+        log_py.merge(cubic)
+    with pytest.raises(UnequalSketchParametersError):
+        cubic.merge(log_py)
+    with pytest.raises(UnequalSketchParametersError):
+        cubic.merge(log_jx)
+    with pytest.raises(UnequalSketchParametersError):
+        log_jx.merge(cubic)

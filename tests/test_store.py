@@ -185,3 +185,29 @@ def test_extreme_keys():
         assert s.count == 3
         assert sum(s.bins) == pytest.approx(3)
         assert len(s.bins) <= 16
+
+
+def test_merge_mixed_types_into_empty_respects_own_semantics():
+    # ADVICE round 1: adopting the operand's bins wholesale let an empty
+    # store inherit foreign collapse semantics.  Mixed-type merges must
+    # re-bin through the receiver's own add path instead.
+    wide = DenseStore()
+    for key in range(-100, 100):
+        wide.add(key)
+
+    bounded = CollapsingLowestDenseStore(8)
+    bounded.merge(wide)
+    assert len(bounded.bins) <= 8
+    assert bounded.count == wide.count  # mass conserved into the floor bin
+    assert bounded.is_collapsed
+
+    collapsed = CollapsingLowestDenseStore(8)
+    for key in range(100):
+        collapsed.add(key)
+    assert collapsed.is_collapsed
+    unbounded = DenseStore()
+    unbounded.merge(collapsed)
+    assert not hasattr(unbounded, "is_collapsed")
+    unbounded.add(-500)  # an unbounded store must still extend downward
+    assert unbounded.min_key == -500
+    assert unbounded.count == collapsed.count + 1
