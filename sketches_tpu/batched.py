@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
+from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
 
 __all__ = [
     "SketchSpec",
@@ -184,12 +185,19 @@ def _keys_and_masks(spec: SketchSpec, values: jax.Array):
     """values [.., S] -> (clamped bin index [.., S] int32, masks, clamp masks).
 
     The branch-free analog of ``BaseDDSketch.add``'s three-way dispatch.
-    NaNs fail every comparison and land in the zero path, matching the host
-    tier's behavior.
+    The zero bucket is defined *explicitly* as |v| below the smallest
+    positive normal of the working dtype -- not left to the backend's
+    flush-to-zero behavior -- so classification is identical on TPU, CPU,
+    and non-FTZ backends.  NaNs fail both comparisons and land in the zero
+    path, matching the host tier.
     """
-    v = values.astype(spec.dtype)
-    is_pos = v > jnp.asarray(0.0, spec.dtype)
-    is_neg = v < jnp.asarray(0.0, spec.dtype)
+    # jnp conversion first: the threshold must follow the *canonicalized*
+    # dtype (with x64 off, a float64 spec runs in f32), and a raw numpy f64
+    # input would otherwise carry a threshold that truncates to 0.
+    v = jnp.asarray(values).astype(spec.dtype)
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    is_pos = v >= tiny
+    is_neg = v <= -tiny
     is_zero = jnp.logical_not(jnp.logical_or(is_pos, is_neg))
     # Neutral operand keeps log() finite on masked lanes.
     absv = jnp.where(is_zero, jnp.asarray(1.0, spec.dtype), jnp.abs(v))
@@ -225,7 +233,7 @@ def add(
     zero-count path with min/max untouched and ``sum`` poisoned to NaN,
     matching the host tier exactly.
     """
-    v = values.astype(spec.dtype)
+    v = jnp.asarray(values).astype(spec.dtype)
     if weights is None:
         w = jnp.ones_like(v)
     else:
@@ -311,8 +319,8 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
                        _last_occupied(state.bins_pos)[:, None])
 
     key_lo = jnp.int32(spec.key_offset)
-    val_neg = -spec.mapping.value_array(idx_neg + key_lo)
-    val_pos = spec.mapping.value_array(idx_pos + key_lo)
+    val_neg = -spec.mapping.value_array(idx_neg + key_lo, dtype=spec.dtype)
+    val_pos = spec.mapping.value_array(idx_pos + key_lo, dtype=spec.dtype)
 
     in_neg = rank < neg_count[:, None]
     in_zero = rank < (neg_count + state.zero_count)[:, None]
@@ -414,8 +422,8 @@ class BatchedDDSketch:
 
         if engine == "pallas" and not kernels.supports(spec, n_streams):
             raise ValueError(
-                "engine='pallas' requires the logarithmic mapping, 128-aligned"
-                f" n_bins and n_streams; got {spec} with n_streams={n_streams}"
+                "engine='pallas' requires f32 state and 128-aligned n_bins"
+                f" and n_streams; got {spec} with n_streams={n_streams}"
             )
         use_pallas = engine == "pallas" or (
             engine == "auto"
@@ -423,8 +431,8 @@ class BatchedDDSketch:
             and kernels.supports(spec, n_streams)
         )
         self.engine = "pallas" if use_pallas else "xla"
-        # The XLA add stays available even on the Pallas engine: it takes the
-        # batch widths and weighted adds the kernels do not.
+        # The XLA add stays available even on the Pallas engine: it takes
+        # the non-128-aligned batch widths the kernels do not.
         self._add_xla = jax.jit(
             functools.partial(add, spec), donate_argnums=(0,)
         )
@@ -464,13 +472,7 @@ class BatchedDDSketch:
                 weights = weights[:, None]
         if values.ndim == 1:
             values = values[:, None]
-        # Weighted adds take the XLA engine: the kernel's bf16 one-hot operand
-        # quantizes non-integer weights (see kernels.add).
-        if (
-            self._add_pallas is not None
-            and weights is None
-            and self._batch_ok(values.shape[-1])
-        ):
+        if self._add_pallas is not None and self._batch_ok(values.shape[-1]):
             self.state = self._add_pallas(self.state, values, weights)
         else:
             self.state = self._add_xla(self.state, values, weights)

@@ -26,7 +26,7 @@ import typing
 
 import numpy as np
 
-from sketches_tpu.mapping import KeyMapping, LogarithmicMapping
+from sketches_tpu.mapping import KeyMapping, LogarithmicMapping, zero_threshold
 from sketches_tpu.store import (
     CollapsingHighestDenseStore,
     CollapsingLowestDenseStore,
@@ -45,6 +45,7 @@ __all__ = [
 
 DEFAULT_REL_ACC = 0.01
 DEFAULT_BIN_LIMIT = 2048
+_F32_TINY = zero_threshold(np.float32)  # shared zero-bucket threshold
 
 
 class UnequalSketchParametersError(ValueError):
@@ -290,12 +291,13 @@ class JaxDDSketch(BaseDDSketch):
             self._min = val
         if val > self._max:
             self._max = val
-        # Classify zero with the *device's* semantics -- sign test after the
-        # f32 cast -- not the host mapping's f64 min_possible: values that
-        # underflow to 0.0 in f32 land in the device zero path, and the host
-        # counter must agree or cross-backend merges drop that mass.
-        vf = float(np.float32(val))
-        if not (vf > 0.0 or vf < 0.0):  # zero, f32-underflow, or NaN
+        # Classify zero with the *device's* semantics -- f32 cast plus the
+        # TPU/XLA flush-to-zero treatment of subnormals -- not the host
+        # mapping's f64 min_possible: anything the device lands in its zero
+        # path must count as zero here too, or cross-backend merges drop
+        # that mass.  Subnormal f32 magnitudes (< ~1.18e-38) flush on
+        # device; NaN fails the >= comparison and lands here as well.
+        if not abs(float(np.float32(val))) >= _F32_TINY:
             self._zero_count += weight
         if len(self._pending_vals) >= self._FLUSH_CHUNK:
             self._flush()

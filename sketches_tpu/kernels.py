@@ -22,10 +22,14 @@ that headroom -- this is the classic TPU histogram trick.)
 compare-and-reduce the VPU eats -- then the three-way negative/zero/positive
 select and the gamma**k decode, for all requested quantiles in one pass.
 
-Both kernels currently require the ``logarithmic`` mapping (the default;
-``jnp.frexp`` used by the interpolated mappings does not lower in Mosaic)
-and 128-aligned shapes; ``supports(spec, ...)`` reports eligibility and the
-facade falls back to the XLA path otherwise.
+All three mappings run in-kernel (the interpolated ones extract
+exponent/mantissa by int32 bitcast -- ``mapping._frexp_array`` -- which
+lowers in Mosaic where ``jnp.frexp`` does not).  Weighted ingest splits each
+f32 weight into three bf16 terms (successive rounding residuals: 3 x 8
+mantissa bits cover f32's 24) and accumulates one bf16 matmul per term --
+full f32 weight precision at the unit path's VMEM footprint.  Shapes must be
+128-aligned; ``supports(spec, ...)`` reports eligibility and the facade
+falls back to the XLA path otherwise.
 """
 
 from __future__ import annotations
@@ -39,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from sketches_tpu.batched import SketchSpec, SketchState
+from sketches_tpu.mapping import zero_threshold
 
 __all__ = ["supports", "ingest_histogram", "fused_quantile", "add"]
 
@@ -50,8 +55,7 @@ _BS = 128  # values per chunk
 def supports(spec: SketchSpec, n_streams: int, batch: Optional[int] = None) -> bool:
     """Whether the Pallas engine can run this configuration."""
     return (
-        spec.mapping_name == "logarithmic"
-        and spec.n_bins % LO == 0
+        spec.n_bins % LO == 0
         and spec.n_bins >= LO
         and jnp.dtype(spec.dtype) == jnp.float32
         and n_streams % _BN == 0
@@ -73,6 +77,7 @@ def _ingest_kernel(
     chigh_ref,
     *,
     spec: SketchSpec,
+    weighted: bool,
 ):
     """One (stream-block, value-chunk) grid cell of the fused ingest.
 
@@ -88,10 +93,12 @@ def _ingest_kernel(
     w = weights_ref[:]
 
     # Branch-free three-way split + key computation, sharing the mapping's
-    # own array path (mapping.LogarithmicMapping) so bucket boundaries are
-    # bit-identical to the XLA engine's _keys_and_masks.
-    is_pos = v > 0.0
-    is_neg = v < 0.0
+    # own array path so bucket boundaries are bit-identical to the XLA
+    # engine's _keys_and_masks -- including its explicit subnormals-are-zero
+    # predicate (backend-independent, not hardware flush-to-zero).
+    tiny = jnp.float32(zero_threshold(jnp.float32))
+    is_pos = v >= tiny
+    is_neg = v <= -tiny
     is_zero = jnp.logical_not(jnp.logical_or(is_pos, is_neg))
     absv = jnp.where(is_zero, 1.0, jnp.abs(v))
     keys = spec.mapping.key_array(absv)
@@ -131,16 +138,30 @@ def _ingest_kernel(
         clow_ref[:] = jnp.zeros_like(clow_ref)
         chigh_ref[:] = jnp.zeros_like(chigh_ref)
 
-    # A[n, h, s] = (hi[n, s] == h) * w[n, s] in bf16.  Weights are exact in
-    # bf16 only for small integers (counts); the facade routes non-unit
-    # weights to the XLA engine.
+    # A[n, h, s] = (hi[n, s] == h) * w[n, s] in bf16.  Unit weights (w = 1)
+    # are exact in one bf16 term.  Arbitrary f32 weights are split into
+    # three bf16 terms (w = p0 + p1 + p2, successive rounding residuals:
+    # 3 x 8 mantissa bits >= f32's 24, so the split is exact) and the
+    # histogram accumulates one bf16 matmul per term -- full f32 weight
+    # precision at bf16 VMEM footprint, cheaper than a HIGHEST f32 matmul.
+    onehot_hi = (hi[:, None, :] == hi_iota).astype(jnp.bfloat16)  # [BN, HI, BS]
+    n_terms = 3 if weighted else 1
+    # Clamp each term into bf16's finite range: weights above bf16 max
+    # (~3.39e38, a sliver below f32 max) would round to inf and inf * 0
+    # one-hot slots would NaN the whole histogram.  Such weights split
+    # across terms with ~2e-10 relative error instead.
+    bf16_max = jnp.float32(3.3895314e38)
     for w_signed, out_ref in ((w_pos, hist_pos_ref), (w_neg, hist_neg_ref)):
-        a = (hi[:, None, :] == hi_iota).astype(jnp.bfloat16) * w_signed[
-            :, None, :
-        ].astype(jnp.bfloat16)  # [BN, HI, BS]
-        c = jax.lax.dot_general(
-            a, onehot_lo, dims, preferred_element_type=jnp.float32
-        )  # [BN, HI, LO]
+        c = jnp.zeros((bn, hi_size, LO), jnp.float32)
+        rem = w_signed
+        for _ in range(n_terms):
+            part = jnp.clip(rem, -bf16_max, bf16_max).astype(jnp.bfloat16)
+            rem = rem - part.astype(jnp.float32)
+            # bf16 multiply by a 0/1 one-hot is exact.
+            a = onehot_hi * part[:, None, :]  # [BN, HI, BS] bf16
+            c = c + jax.lax.dot_general(
+                a, onehot_lo, dims, preferred_element_type=jnp.float32
+            )  # [BN, HI, LO]
         out_ref[:] += c.reshape(bn, n_bins)
 
     zero_ref[:] += jnp.sum(w_zero, axis=1, keepdims=True)
@@ -167,6 +188,7 @@ def ingest_histogram(
     values: jax.Array,
     weights: jax.Array,
     *,
+    weighted: bool = True,
     interpret: bool = False,
 ) -> Tuple[jax.Array, ...]:
     """One fused pass over a value batch -> histograms + scalar bookkeeping.
@@ -185,7 +207,7 @@ def ingest_histogram(
     )
     col_spec = pl.BlockSpec((_BN, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        functools.partial(_ingest_kernel, spec=spec),
+        functools.partial(_ingest_kernel, spec=spec, weighted=weighted),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BN, _BS), lambda i, j: (i, j), memory_space=pltpu.VMEM),
@@ -356,11 +378,9 @@ def add(
 ) -> SketchState:
     """Drop-in replacement for ``batched.add`` using the fused Pallas pass.
 
-    Weights note: inside the kernel, weights ride the bf16 one-hot operand,
-    which is exact for unit/small-integer weights (counts) but quantizes
-    arbitrary floats.  The facade therefore routes weighted adds to the XLA
-    engine; call this directly only with unit weights or weights that are
-    exactly representable in bf16.
+    Unit-weight calls (``weights=None``) take the single-term bf16 one-hot
+    path; explicit weights use the exact three-term bf16 split (see module
+    docstring), so arbitrary f32 weights accumulate without quantization.
     """
     v = values.astype(spec.dtype)
     if weights is None:
@@ -369,7 +389,9 @@ def add(
         w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
 
     (hist_pos, hist_neg, zero, count, total, vmin, vmax, clow, chigh) = (
-        ingest_histogram(spec, v, w, interpret=interpret)
+        ingest_histogram(
+            spec, v, w, weighted=weights is not None, interpret=interpret
+        )
     )
     return SketchState(
         bins_pos=state.bins_pos + hist_pos,

@@ -37,10 +37,20 @@ import math
 import sys
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-_F32_FINFO = np.finfo(np.float32)
+def zero_threshold(dtype) -> float:
+    """|v| below this lands in the zero bucket: the smallest positive normal
+    of ``dtype``.
+
+    The single definition shared by the host tier, the XLA engine, and the
+    Pallas kernels -- all three must classify subnormals identically or
+    cross-backend merges lose mass (the predicate is explicit rather than
+    inherited from a backend's flush-to-zero behavior).
+    """
+    return float(np.finfo(np.dtype(dtype).name).tiny)
 
 __all__ = [
     "KeyMapping",
@@ -108,22 +118,27 @@ class KeyMapping:
         may fuse the scale to keep f32 intermediates from overflowing."""
         return self._pow_gamma_array(k) * jnp.float32(2.0 / (1.0 + self.gamma))
 
-    def value_array(self, key):
-        """Elementwise ``value`` for an int array of keys -> f32 values.
+    def value_array(self, key, dtype=jnp.float32):
+        """Elementwise ``value`` for an int array of keys -> float values.
 
-        *Saturating*: results clamp to the positive finite f32 range.  A key
-        window may contain buckets whose true representative is outside f32
-        (wide windows; the very top representable bucket, whose midpoint can
-        round past f32 max) -- those decode to the nearest positive finite
-        f32 instead of inf/0, keeping device quantiles finite everywhere the
-        f64 host tier's are (ADVICE round 1).
+        *Saturating*: results clamp to the positive finite range of
+        ``dtype``.  A key window may contain buckets whose true
+        representative is outside the dtype (wide windows; the very top
+        representable bucket, whose midpoint can round past the max) --
+        those decode to the nearest positive finite value instead of inf/0,
+        keeping device quantiles finite everywhere the f64 host tier's are
+        (ADVICE round 1).
         """
-        k = key.astype(jnp.float32) - jnp.float32(self._offset)
-        fin = _F32_FINFO
+        k = key.astype(jnp.dtype(dtype))  # canonicalizes (f64 -> f32 sans x64)
+        k = k - jnp.asarray(self._offset, k.dtype)
+        raw = self._scaled_pow_gamma_array(k)
+        # Bounds from the *canonicalized* dtype: f64 bounds in an f32 world
+        # would cast to (0, inf) and silently disable the saturation.
+        fin = jnp.finfo(raw.dtype)
         return jnp.clip(
-            self._scaled_pow_gamma_array(k),
-            jnp.float32(fin.tiny),
-            jnp.float32(fin.max),
+            raw,
+            jnp.asarray(fin.tiny, raw.dtype),
+            jnp.asarray(fin.max, raw.dtype),
         )
 
     # -- equality / identity ----------------------------------------------
@@ -171,14 +186,64 @@ class LogarithmicMapping(KeyMapping):
         )
 
 
+def _float_layout(dtype):
+    """(int type, mantissa bits, exponent mask, max biased exponent) of an
+    IEEE float dtype -- the constants the bit-twiddled frexp/ldexp need."""
+    if jnp.dtype(dtype) == jnp.float64:
+        return jnp.int64, 52, 0x7FF, 2046
+    return jnp.int32, 23, 0xFF, 254
+
+
 def _frexp_array(value):
     """(mantissa in [0.5, 1), integer exponent) such that v = m * 2**e.
 
-    jnp.frexp exists but we inline via exponent extraction so the same
-    expression lowers cleanly inside Pallas kernels.
+    ``jnp.frexp`` has no Mosaic lowering, so the split is done by integer
+    bit-twiddling on the float representation -- the identical expression
+    runs under XLA and inside Pallas kernels, for f32 and (under x64) f64.
+    Subnormal inputs are pre-scaled by 2**mant_bits (which exactly
+    normalizes the whole subnormal range) and the exponent corrected back.
+    ``value`` must be positive and finite.
     """
-    m, e = jnp.frexp(value)
-    return m, e.astype(jnp.float32)
+    v = jnp.asarray(value)
+    if v.dtype not in (jnp.float32, jnp.float64):
+        v = v.astype(jnp.float32)
+    int_t, mant_bits, exp_mask, _ = _float_layout(v.dtype)
+    half_biased = (exp_mask >> 1) - 1  # biased exponent of 0.5
+    bits0 = jax.lax.bitcast_convert_type(v, int_t)
+    is_sub = (bits0 >> mant_bits) == 0  # biased exp 0 and v > 0 => subnormal
+    scaled = jnp.where(is_sub, v * v.dtype.type(2.0) ** mant_bits, v)
+    bits = jax.lax.bitcast_convert_type(scaled, int_t)
+    biased = (bits >> mant_bits) & exp_mask
+    # Force the exponent field to that of 0.5: mantissa lands in [0.5, 1).
+    mant_mask = int_t((1 << mant_bits) - 1)
+    m_bits = (bits & mant_mask) | int_t(half_biased << mant_bits)
+    m = jax.lax.bitcast_convert_type(m_bits, v.dtype)
+    e = biased - half_biased - jnp.where(is_sub, mant_bits, 0)
+    return m, e.astype(v.dtype)
+
+
+def _exp2i(e, dtype):
+    """2.0**e built in the exponent field, for e within the normal range."""
+    int_t, mant_bits, exp_mask, _ = _float_layout(dtype)
+    bias = exp_mask >> 1
+    return jax.lax.bitcast_convert_type(
+        ((e + bias) << mant_bits).astype(int_t), dtype
+    )
+
+
+def _ldexp_array(m, e):
+    """m * 2**e without ``jnp.ldexp`` (no Mosaic lowering).
+
+    Two power-of-two factors cover exponents beyond the single-factor
+    normal range; results outside the dtype saturate (callers clip anyway).
+    """
+    dt = jnp.asarray(m).dtype
+    _, _, exp_mask, _ = _float_layout(dt)
+    lo, hi = -(exp_mask >> 1) + 1, exp_mask >> 1
+    e = e.astype(jnp.int64 if dt == jnp.float64 else jnp.int32)
+    a = jnp.clip(e, lo, hi)
+    b = jnp.clip(e - a, lo, hi)
+    return m * _exp2i(a, dt) * _exp2i(b, dt)
 
 
 class LinearlyInterpolatedMapping(KeyMapping):
@@ -219,7 +284,7 @@ class LinearlyInterpolatedMapping(KeyMapping):
         v = value / jnp.float32(self._multiplier)
         exponent = jnp.floor(v)
         mantissa = (v - exponent + 1.0) / 2.0
-        return jnp.ldexp(mantissa, exponent.astype(jnp.int32) + 1)
+        return _ldexp_array(mantissa, exponent + 1.0)
 
 
 class CubicallyInterpolatedMapping(KeyMapping):
@@ -291,7 +356,7 @@ class CubicallyInterpolatedMapping(KeyMapping):
         for _ in range(_NEWTON_ITERS):
             s = s - (self._cubic(s) - rem) / self._cubic_deriv(s)
         mantissa = (s + 1.0) / 2.0
-        return jnp.ldexp(mantissa, exponent.astype(jnp.int32) + 1)
+        return _ldexp_array(mantissa, exponent + 1.0)
 
 
 _MAPPING_REGISTRY = {

@@ -380,3 +380,16 @@ def test_f64_dtype_extends_exact_regime():
         assert float(state.count[0]) == 2.0**24 + 1
         got = float(get_quantile_value(spec, state, 0.5)[0])
         assert abs(got - 1.0) <= TEST_REL_ACC + 1e-6  # bound is tight at bucket edges
+
+
+def test_f64_spec_without_x64_still_classifies_zero():
+    # Review round 2: with x64 off, float64 canonicalizes to f32; the zero
+    # threshold must follow the canonicalized dtype or it truncates to 0.0
+    # and exact zeros double-count into both histograms.
+    spec = SketchSpec(relative_accuracy=TEST_REL_ACC, n_bins=128, dtype=jnp.float64)
+    state = init(spec, 1)
+    state = add(spec, state, np.asarray([[0.0, 1.0, -1.0]]))
+    assert float(state.zero_count[0]) == 1.0
+    assert float(state.count[0]) == 3.0
+    assert float(state.bins_pos[0].sum()) == 1.0
+    assert float(state.bins_neg[0].sum()) == 1.0

@@ -201,3 +201,17 @@ def test_merge_rejects_same_gamma_different_mapping():
         cubic.merge(log_jx)
     with pytest.raises(UnequalSketchParametersError):
         log_jx.merge(cubic)
+
+
+def test_f32_subnormal_classified_zero_on_both_sides():
+    # Review round 2: subnormal f32 magnitudes flush to zero on device, so
+    # the host counter must classify the whole subnormal range as zero too,
+    # not just full underflow.
+    jx = DDSketch(REL_ACC, backend="jax")
+    jx.add(5e-41)  # f32 subnormal: flushes on device
+    jx.add(5.0)
+    assert jx.zero_count == 1.0
+    py = DDSketch(REL_ACC)
+    py.merge(jx)
+    binned = py.zero_count + py.store.count + py.negative_store.count
+    assert py.count == 2.0 and binned == pytest.approx(2.0)

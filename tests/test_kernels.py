@@ -41,9 +41,14 @@ def test_supports():
     assert not kernels.supports(
         SketchSpec(relative_accuracy=0.01, n_bins=100), 128
     )  # bins not 128-aligned
+    # All three mappings lower in Mosaic (bitcast frexp/ldexp).
+    for name in ("linear_interpolated", "cubic_interpolated"):
+        assert kernels.supports(
+            SketchSpec(relative_accuracy=0.01, mapping_name=name), 128
+        )
     assert not kernels.supports(
-        SketchSpec(relative_accuracy=0.01, mapping_name="cubic_interpolated"), 128
-    )  # only the logarithmic mapping lowers
+        SketchSpec(relative_accuracy=0.01, dtype=jnp.float64), 128
+    )  # kernels are f32-only
 
 
 def test_ingest_parity_with_xla():
@@ -107,14 +112,14 @@ def test_facade_pallas_engine_rejects_unsupported_config():
     with pytest.raises(ValueError, match="pallas"):
         BatchedDDSketch(
             n_streams=128,
-            relative_accuracy=0.01,
-            mapping="cubic_interpolated",
+            spec=SketchSpec(relative_accuracy=0.01, dtype=jnp.float64),
             engine="pallas",
         )
 
 
-def test_facade_routes_weighted_adds_to_xla():
-    """Fractional weights must stay exact (kernel bf16 operand would not)."""
+def test_weighted_adds_stay_exact_through_pallas():
+    """Fractional weights ride the exact bf16-split path without
+    quantization (a single bf16 term would round 1000.5 to 1000)."""
     sk = BatchedDDSketch(n_streams=N, spec=SPEC, engine="pallas")
     w = np.full((N, S), 1000.5, np.float32)
     vals = np.full((N, S), 2.0, np.float32)
@@ -122,6 +127,39 @@ def test_facade_routes_weighted_adds_to_xla():
     assert float(sk.count[0]) == pytest.approx(1000.5 * S, rel=1e-6)
     assert float(np.asarray(sk.state.bins_pos[0]).sum()) == pytest.approx(
         1000.5 * S, rel=1e-6
+    )
+
+
+@pytest.mark.parametrize(
+    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+)
+def test_weighted_ingest_and_quantile_parity_all_mappings(mapping):
+    """Every mapping x arbitrary f32 weights: kernel == XLA engine."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=2048, mapping_name=mapping)
+    vals = jnp.asarray(_mixed_values())
+    w = jnp.asarray(
+        np.random.RandomState(3).uniform(0.25, 3.75, (N, S)).astype(np.float32)
+    )
+    ref = xla_add(spec, init(spec, N), vals, w)
+    got = kernels.add(spec, init(spec, N), vals, w, interpret=True)
+    for f in (
+        "bins_pos", "bins_neg", "zero_count", "count", "sum", "min", "max",
+        "collapsed_low", "collapsed_high",
+    ):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, f)),
+            np.asarray(getattr(ref, f)),
+            rtol=1e-5,
+            atol=1e-5,
+            err_msg=f"{mapping}:{f}",
+        )
+    qs = jnp.asarray([0.0, 0.25, 0.5, 0.99, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(kernels.fused_quantile(spec, got, qs, interpret=True)),
+        np.asarray(xla_quantile(spec, ref, qs)),
+        rtol=1e-4,
+        equal_nan=True,
+        err_msg=mapping,
     )
 
 
@@ -165,3 +203,17 @@ def test_accuracy_contract_through_kernel():
         for j, q in enumerate([0.25, 0.5, 0.99]):
             exact = np.quantile(data[i], q, method="lower")
             assert abs(got[i, j] - exact) <= 0.0102 * abs(exact) + 1e-9
+
+
+def test_extreme_weights_do_not_poison_histogram():
+    """Weights above bf16 max must not round to inf and NaN the bins."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128, key_offset=-64)
+    vals = np.ones((128, 128), np.float32)
+    w = np.ones((128, 128), np.float32)
+    w[0, 0] = 3.4e38  # finite f32, above bf16 max
+    got = kernels.add(
+        spec, init(spec, 128), jnp.asarray(vals), jnp.asarray(w), interpret=True
+    )
+    bins = np.asarray(got.bins_pos)
+    assert np.isfinite(bins).all()
+    np.testing.assert_allclose(bins[0].sum(), 3.4e38 + 127.0, rtol=1e-6)

@@ -129,3 +129,18 @@ def test_min_max_possible_guard():
     assert m.min_possible > 0
     v = m.min_possible * 2
     assert m.value(m.key(v)) == pytest.approx(v, rel=0.01)
+
+
+def test_f64_array_path_under_x64():
+    # Review round 2: the bitcast frexp/ldexp must stay dtype-generic -- a
+    # forced f32 cast would garble keys for out-of-f32-range f64 values.
+    import jax
+
+    with jax.enable_x64(True):
+        for name in ("linear_interpolated", "cubic_interpolated", "logarithmic"):
+            m = mapping_from_name(name, 0.01)
+            vals = np.asarray([1e-100, 1e-3, 1.0, 7.5, 1e100], np.float64)
+            keys = m.key_array(jnp.asarray(vals))
+            recon = np.asarray(m.value_array(keys, dtype=jnp.float64), np.float64)
+            relerr = np.abs(recon - vals) / vals
+            assert relerr.max() <= 0.0101, (name, relerr)
