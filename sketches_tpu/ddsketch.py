@@ -254,16 +254,21 @@ class JaxDDSketch(BaseDDSketch):
         self,
         relative_accuracy: typing.Optional[float] = None,
         n_bins: typing.Optional[int] = None,
+        mapping: str = "logarithmic",
+        key_offset: typing.Optional[int] = None,
     ):
         from sketches_tpu import batched
+        from sketches_tpu.mapping import mapping_from_name
 
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
         self._spec = batched.SketchSpec(
             relative_accuracy=relative_accuracy,
+            mapping_name=mapping,
             n_bins=DEFAULT_BIN_LIMIT if n_bins is None else n_bins,
+            key_offset=key_offset,
         )
-        self._mapping = LogarithmicMapping(relative_accuracy)
+        self._mapping = mapping_from_name(mapping, relative_accuracy)
         self._relative_accuracy = relative_accuracy
         self._state = batched.init(self._spec, 1)
         self._flush_fn, self._quantile_fn, self._merge_fn = self._jitted_ops(
@@ -361,7 +366,12 @@ class JaxDDSketch(BaseDDSketch):
         import jax
 
         self._flush()
-        new = JaxDDSketch(self._relative_accuracy, n_bins=self._spec.n_bins)
+        new = JaxDDSketch(
+            self._relative_accuracy,
+            n_bins=self._spec.n_bins,
+            mapping=self._spec.mapping_name,
+            key_offset=self._spec.key_offset,
+        )
         new._state = jax.tree.map(jax.numpy.copy, self._state)
         new._zero_count = self._zero_count
         new._count = self._count
@@ -405,9 +415,14 @@ class DDSketch(BaseDDSketch):
         relative_accuracy: typing.Optional[float] = None,
         backend: str = "py",
     ):
-        if backend == "jax" and cls is DDSketch:
+        if backend == "jax":
+            if cls is not DDSketch:
+                raise NotImplementedError(
+                    f"backend='jax' is not inherited by subclass {cls.__name__};"
+                    " construct JaxDDSketch directly"
+                )
             return JaxDDSketch(relative_accuracy)
-        if backend not in ("py", "jax"):
+        if backend != "py":
             raise ValueError(f"Unknown backend {backend!r}")
         return super().__new__(cls)
 
@@ -425,16 +440,56 @@ class DDSketch(BaseDDSketch):
         )
 
 
+def _jax_collapsing_sketch(
+    relative_accuracy: typing.Optional[float],
+    bin_limit: typing.Optional[int],
+) -> "JaxDDSketch":
+    """The jax backend for both collapsing presets.
+
+    The device tier is *always*-collapsing (static ``bin_limit``-bin window,
+    mass clamping at both edges with observability counters), which bounds
+    memory exactly like the reference presets.  The difference -- documented,
+    inherent to static shapes -- is that the py presets slide their window
+    to follow the data (pinning the kept end) while the device window is
+    fixed at construction, centered on ``key(1.0) = 0``.
+    """
+    # Degenerate limits (< 2, incl. the py tier's accepted 0/1) fall back to
+    # the default, same as negative values: the device window needs >= 2 bins.
+    if bin_limit is None or bin_limit < 2:
+        bin_limit = DEFAULT_BIN_LIMIT
+    return JaxDDSketch(relative_accuracy, n_bins=bin_limit)
+
+
 class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
     """LogarithmicMapping + CollapsingLowestDenseStore (bounded memory).
 
     Reference seam: ``ddsketch/ddsketch.py . LogCollapsingLowestDenseDDSketch``.
+    ``backend='jax'`` bounds memory with the device tier's static window
+    (see ``_jax_collapsing_sketch``).
     """
+
+    def __new__(
+        cls,
+        relative_accuracy: typing.Optional[float] = None,
+        bin_limit: typing.Optional[int] = None,
+        backend: str = "py",
+    ):
+        if backend == "jax":
+            if cls is not LogCollapsingLowestDenseDDSketch:
+                raise NotImplementedError(
+                    f"backend='jax' is not inherited by subclass {cls.__name__};"
+                    " construct JaxDDSketch directly"
+                )
+            return _jax_collapsing_sketch(relative_accuracy, bin_limit)
+        if backend != "py":
+            raise ValueError(f"Unknown backend {backend!r}")
+        return super().__new__(cls)
 
     def __init__(
         self,
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
+        backend: str = "py",
     ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
@@ -451,12 +506,32 @@ class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
     """LogarithmicMapping + CollapsingHighestDenseStore (bounded memory).
 
     Reference seam: ``ddsketch/ddsketch.py . LogCollapsingHighestDenseDDSketch``.
+    ``backend='jax'`` bounds memory with the device tier's static window
+    (see ``_jax_collapsing_sketch``).
     """
+
+    def __new__(
+        cls,
+        relative_accuracy: typing.Optional[float] = None,
+        bin_limit: typing.Optional[int] = None,
+        backend: str = "py",
+    ):
+        if backend == "jax":
+            if cls is not LogCollapsingHighestDenseDDSketch:
+                raise NotImplementedError(
+                    f"backend='jax' is not inherited by subclass {cls.__name__};"
+                    " construct JaxDDSketch directly"
+                )
+            return _jax_collapsing_sketch(relative_accuracy, bin_limit)
+        if backend != "py":
+            raise ValueError(f"Unknown backend {backend!r}")
+        return super().__new__(cls)
 
     def __init__(
         self,
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
+        backend: str = "py",
     ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
