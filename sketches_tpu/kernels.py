@@ -253,6 +253,119 @@ def _cumsum_bins(x: jax.Array) -> jax.Array:
     return (local + offsets[:, :, None]).reshape(bn, n_bins)
 
 
+def _suffix_cumsum_bins(x: jax.Array) -> jax.Array:
+    """Inclusive suffix sum along the bin axis (mirror of _cumsum_bins).
+
+    Exists for one property the prefix sum cannot give: bins strictly after
+    the last occupied bin have suffix sum *exactly* 0.0 (sums of empty/zero
+    sets are exact in f32), so ``suffix <= 0`` finds the last occupied bin
+    robustly.  Comparing the prefix sum against the row total is NOT robust:
+    different MXU reduction trees can put the trailing plateau a few ULPs
+    away from ``cum[-1]``.
+    """
+    bn, n_bins = x.shape
+    hi_size = n_bins // LO
+    x3 = x.reshape(bn, hi_size, LO)
+    tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (LO, LO), 0)
+        >= jax.lax.broadcasted_iota(jnp.int32, (LO, LO), 1)
+    ).astype(jnp.float32)
+    local = jax.lax.dot_general(
+        x3, tri, (((2,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [bn, HI, LO] block-local inclusive suffix sum
+    totals = local[:, :, 0]  # [bn, HI]
+    tri_excl = (
+        jax.lax.broadcasted_iota(jnp.int32, (hi_size, hi_size), 0)
+        > jax.lax.broadcasted_iota(jnp.int32, (hi_size, hi_size), 1)
+    ).astype(jnp.float32)
+    offsets = jax.lax.dot_general(
+        totals, tri_excl, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # [bn, HI] exclusive suffix sum of block totals
+    return (local + offsets[:, :, None]).reshape(bn, n_bins)
+
+
+def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, qs):
+    """The rank-selection math shared by the standalone query kernel and the
+    fused ingest+query kernel -> values [BN, Q].
+
+    All bin walks are *mask-matmuls*: every needed index is "count of bins
+    whose cumulative mass is below a threshold", and because cum is
+    monotone, first/last-occupied are the same shape of count (bins before
+    the first occupied have cum == 0; bins at/after the last have
+    cum == total).  Stacking all 4 + 2Q masks into one bf16 tensor and
+    contracting the bin axis against ones on the MXU replaces the VPU's
+    slow lane-axis reductions (which dominated the kernel: ~4x this cost).
+    """
+    bn, n_bins = bins_pos.shape
+    q_total = qs.shape[1]
+    neg_count = None  # derived from cum below; bins are never negative
+
+    cum_pos = _cumsum_bins(bins_pos)  # [BN, B]
+    cum_neg = _cumsum_bins(bins_neg)
+    pos_total = cum_pos[:, n_bins - 1 :]  # [BN, 1]
+    neg_count = cum_neg[:, n_bins - 1 :]
+    rank = qs * (count - 1.0)  # [BN, Q]
+
+    # Masks, each [BN, B] bf16 (0/1 exact):
+    #   0: first_pos = #(cum_pos <= 0)            3: #trailing-zeros(neg)
+    #   1: #trailing-zeros(pos)                   4..3+Q: idx_neg per q
+    #   2: first_neg = #(cum_neg <= 0)            4+Q..3+2Q: idx_pos per q
+    # First/last come from exact zero tests on the prefix/suffix sums
+    # (leading and trailing zero runs are exactly 0.0 by construction).
+    masks = [
+        cum_pos <= 0.0,
+        _suffix_cumsum_bins(bins_pos) <= 0.0,
+        cum_neg <= 0.0,
+        _suffix_cumsum_bins(bins_neg) <= 0.0,
+    ]
+    rev = neg_count - 1.0 - rank  # [BN, Q]
+    pos_rank = rank - zero_count - neg_count
+    for qi in range(q_total):
+        masks.append(cum_neg < rev[:, qi][:, None] + 1.0)
+    for qi in range(q_total):
+        masks.append(cum_pos <= pos_rank[:, qi][:, None])
+    # Contract in groups of <= 8 masks to bound the stacked tensor's VMEM
+    # footprint ([BN, 8, B] bf16) independent of Q.
+    ones = jnp.ones((n_bins, 8), jnp.bfloat16)  # 8 lanes: MXU-friendly matvec
+    parts = []
+    for g in range(0, len(masks), 8):
+        m3 = jnp.stack(masks[g : g + 8], axis=1).astype(jnp.bfloat16)
+        parts.append(
+            jax.lax.dot_general(
+                m3, ones, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )[:, :, 0]
+        )
+    counts = jnp.concatenate(parts, axis=1).astype(jnp.int32)  # [BN, M]
+
+    first_pos = counts[:, 0:1]
+    last_pos = n_bins - 1 - counts[:, 1:2]
+    first_neg = counts[:, 2:3]
+    last_neg = n_bins - 1 - counts[:, 3:4]
+    idx_neg = jnp.clip(counts[:, 4 : 4 + q_total], first_neg, last_neg)
+    idx_pos = jnp.clip(counts[:, 4 + q_total :], first_pos, last_pos)
+
+    # Decode all Q indices at once through the mapping's own array path
+    # (bit-identical bucket representatives to the XLA engine).
+    key_lo = jnp.int32(spec.key_offset)
+    val_neg = -spec.mapping.value_array(idx_neg + key_lo)  # [BN, Q]
+    val_pos = spec.mapping.value_array(idx_pos + key_lo)
+
+    val = jnp.where(
+        rank < neg_count,
+        val_neg,
+        jnp.where(rank < neg_count + zero_count, 0.0, val_pos),
+    )
+    valid = jnp.logical_and(
+        jnp.logical_and(qs >= 0.0, qs <= 1.0), count > 0.0
+    )
+    return jnp.where(valid, val, jnp.nan)  # [BN, Q]
+
+
 def _quantile_kernel(
     bins_pos_ref,
     bins_neg_ref,
@@ -264,64 +377,14 @@ def _quantile_kernel(
     spec: SketchSpec,
 ):
     """One stream-block of the fused multi-quantile query."""
-    bins_pos = bins_pos_ref[:]  # [BN, B]
-    bins_neg = bins_neg_ref[:]
-    zero_count = zero_count_ref[:]  # [BN, 1]
-    count = count_ref[:]  # [BN, 1]
-    qs = qs_ref[:]  # [1, Q]
-
-    bn, n_bins = bins_pos.shape
-    neg_count = jnp.sum(bins_neg, axis=1, keepdims=True)  # [BN, 1]
-    rank = qs * (count - 1.0)  # [BN, Q]
-
-    cum_pos = _cumsum_bins(bins_pos)
-    cum_neg = _cumsum_bins(bins_neg)
-
-    iota = jax.lax.broadcasted_iota(jnp.int32, (bn, n_bins), 1)
-    first_pos = jnp.min(
-        jnp.where(bins_pos > 0, iota, n_bins - 1), axis=1, keepdims=True
+    out_ref[:] = _select_quantiles(
+        spec,
+        bins_pos_ref[:],
+        bins_neg_ref[:],
+        zero_count_ref[:],
+        count_ref[:],
+        qs_ref[:],
     )
-    last_pos = jnp.max(jnp.where(bins_pos > 0, iota, 0), axis=1, keepdims=True)
-    first_neg = jnp.min(
-        jnp.where(bins_neg > 0, iota, n_bins - 1), axis=1, keepdims=True
-    )
-    last_neg = jnp.max(jnp.where(bins_neg > 0, iota, 0), axis=1, keepdims=True)
-
-    # index = #bins with cum <= target  ==  searchsorted(side='right').
-    # [BN, B] x [BN, Q] compare-count; Q is small so loop it statically.
-    q_total = rank.shape[1]
-    key_lo = jnp.int32(spec.key_offset)
-
-    for qi in range(q_total):
-        r = rank[:, qi][:, None]  # [BN, 1]
-        # negative branch: smallest index with cum >= rev_rank + 1
-        rev = neg_count - 1.0 - r
-        idx_neg = jnp.sum(
-            (cum_neg < rev + 1.0).astype(jnp.int32), axis=1, keepdims=True
-        )
-        idx_neg = jnp.clip(idx_neg, first_neg, last_neg)
-        # positive branch: smallest index with cum > pos_rank
-        pos_rank = r - zero_count - neg_count
-        idx_pos = jnp.sum(
-            (cum_pos <= pos_rank).astype(jnp.int32), axis=1, keepdims=True
-        )
-        idx_pos = jnp.clip(idx_pos, first_pos, last_pos)
-
-        # Decode through the mapping's own array path (bit-identical to the
-        # XLA engine's bucket representatives).
-        def decode(idx):
-            return spec.mapping.value_array(idx + key_lo)
-
-        val = jnp.where(
-            r < neg_count,
-            -decode(idx_neg),
-            jnp.where(r < neg_count + zero_count, 0.0, decode(idx_pos)),
-        )
-        q = qs[0, qi]
-        valid = jnp.logical_and(
-            jnp.logical_and(q >= 0.0, q <= 1.0), count > 0.0
-        )
-        out_ref[:, qi] = jnp.where(valid, val, jnp.nan)[:, 0]
 
 
 def fused_quantile(
