@@ -54,10 +54,16 @@ def _maybe_trace(enabled: bool, name: str):
     import jax
 
     try:
-        with jax.profiler.trace(f"traces/{name}"):
-            yield
+        ctx = jax.profiler.trace(f"traces/{name}")
+        ctx.__enter__()
     except Exception:  # tracing unsupported on this runtime: still bench
-        yield
+        ctx = None
+    try:
+        yield  # benchmark-body exceptions must propagate untouched
+    finally:
+        if ctx is not None:
+            with contextlib.suppress(Exception):
+                ctx.__exit__(None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -249,10 +255,13 @@ def bench_distributed(profile: bool):
     spec = SketchSpec(relative_accuracy=0.01, n_bins=1024)
     mesh = Mesh(np.asarray(jax.devices()[:n_devices]), ("streams",))
     n_streams, batch = 128 * n_devices, 1024
-    dist = DistributedDDSketch(n_streams, mesh=mesh, stream_axis="streams", spec=spec)
+    dist = DistributedDDSketch(
+        n_streams, mesh=mesh, value_axis=None, stream_axis="streams", spec=spec
+    )
     values = np.random.RandomState(0).lognormal(0, 2, (n_streams, batch)).astype(np.float32)
     with _maybe_trace(profile, "c3_distributed"):
         dist.add(values)  # compile + warm
+        _ = np.asarray(dist.count)  # sync before the timed window
         t0 = time.perf_counter()
         for _ in range(10):
             dist.add(values)

@@ -302,12 +302,10 @@ def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, qs):
     """
     bn, n_bins = bins_pos.shape
     q_total = qs.shape[1]
-    neg_count = None  # derived from cum below; bins are never negative
 
     cum_pos = _cumsum_bins(bins_pos)  # [BN, B]
     cum_neg = _cumsum_bins(bins_neg)
-    pos_total = cum_pos[:, n_bins - 1 :]  # [BN, 1]
-    neg_count = cum_neg[:, n_bins - 1 :]
+    neg_count = cum_neg[:, n_bins - 1 :]  # [BN, 1]
     rank = qs * (count - 1.0)  # [BN, Q]
 
     # Masks, each [BN, B] bf16 (0/1 exact):
@@ -333,7 +331,12 @@ def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, qs):
     ones = jnp.ones((n_bins, 8), jnp.bfloat16)  # 8 lanes: MXU-friendly matvec
     parts = []
     for g in range(0, len(masks), 8):
-        m3 = jnp.stack(masks[g : g + 8], axis=1).astype(jnp.bfloat16)
+        # Cast each mask bf16 *before* stacking: compare->cast fuses in
+        # Mosaic, but stacking i1 vectors forces a vreg relayout it cannot
+        # compile (bitcast_vreg i1->i32 "Invalid vector register cast").
+        m3 = jnp.stack(
+            [m.astype(jnp.bfloat16) for m in masks[g : g + 8]], axis=1
+        )
         parts.append(
             jax.lax.dot_general(
                 m3, ones, (((2,), (0,)), ((), ())),

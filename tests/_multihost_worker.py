@@ -1,0 +1,100 @@
+"""Worker process for the 2-process multi-host smoke test.
+
+Run as: python _multihost_worker.py <coordinator_port> <process_id> <n_procs>
+
+Each process exposes 4 virtual CPU devices; ``jax.distributed.initialize``
+joins them into one 8-device job, ``make_global_mesh`` lays the job-wide
+mesh, and the DDSketch psum-merge collective folds per-device partial
+histograms across the process (DCN-analog) boundary — the multi-host path
+SURVEY.md section 5 (comm-backend row) requires.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _meshenv import cpu_mesh_env
+
+LOCAL_DEVICES = 4
+
+
+def main() -> None:
+    port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    os.environ.update(cpu_mesh_env(LOCAL_DEVICES, os.environ))
+    import jax
+
+    # The axon sitecustomize hook re-registers the TPU platform at startup;
+    # force the runtime config too (same as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{port}",
+            num_processes=nproc,
+            process_id=pid,
+        )
+    except Exception:
+        import traceback
+
+        traceback.print_exc()
+        print("DISTRIBUTED_UNAVAILABLE")  # parent skips instead of failing
+        sys.exit(2)
+    assert jax.process_count() == nproc, jax.process_count()
+    n_shards = nproc * LOCAL_DEVICES
+    assert len(jax.devices()) == n_shards, jax.devices()
+    assert len(jax.local_devices()) == LOCAL_DEVICES
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sketches_tpu.batched import SketchSpec, add, init, quantile
+    from sketches_tpu.parallel import make_global_mesh, psum_merge, shard_map
+
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    n_streams, chunk = 4, 64
+    mesh = make_global_mesh(("values",))
+    assert mesh.devices.size == n_shards
+
+    # Same deterministic dataset on every process; each of the 8 global
+    # devices ingests its own [n_streams, chunk] slice of the value stream.
+    all_vals = (
+        np.random.RandomState(0)
+        .normal(50.0, 5.0, (n_shards, n_streams, chunk))
+        .astype(np.float32)
+    )
+    sharding = NamedSharding(mesh, P("values", None, None))
+    local = all_vals[pid * LOCAL_DEVICES : (pid + 1) * LOCAL_DEVICES]
+    vals = jax.make_array_from_process_local_data(sharding, local)
+
+    def ingest_and_fold(vals):
+        st = add(spec, init(spec, n_streams), vals[0])
+        return psum_merge(st, "values")  # rides DCN across the two processes
+
+    folded = jax.jit(
+        shard_map(
+            ingest_and_fold,
+            mesh=mesh,
+            in_specs=(P("values", None, None),),
+            out_specs=jax.tree.map(lambda _: P(), init(spec, n_streams)),
+        )
+    )(vals)
+
+    got = np.asarray(
+        jax.jit(lambda st: quantile(spec, st, jnp.asarray([0.25, 0.5, 0.75])))(
+            folded
+        )
+    )
+    assert np.asarray(folded.count).tolist() == [n_shards * chunk] * n_streams
+    merged_per_stream = all_vals.transpose(1, 0, 2).reshape(n_streams, -1)
+    for i in range(n_streams):
+        for j, q in enumerate((0.25, 0.5, 0.75)):
+            exact = np.quantile(merged_per_stream[i], q, method="lower")
+            assert abs(got[i, j] - exact) <= 0.0101 * abs(exact) + 1e-6, (
+                i, q, got[i, j], exact,
+            )
+    jax.distributed.shutdown()
+    print(f"MULTIHOST_OK pid={pid}")
+
+
+if __name__ == "__main__":
+    main()
