@@ -183,6 +183,23 @@ def _device_bench(
         lat.append((time.perf_counter() - t0) / batch_calls)
     lat = np.asarray(lat)
 
+    # Device-sustained query latency: K queries chained in one jit (qs
+    # perturbed per iteration so the loop body is not hoisted as invariant --
+    # the perturbation must survive f32 rounding, hence the relative scale),
+    # removing the per-dispatch tunnel overhead entirely.
+    def _fused_q(state, qs0):
+        def body(i, acc):
+            return acc + q_fn(state, qs0 * (1.0 - jnp.float32(i) * 1e-4)).sum()
+        return jax.lax.fori_loop(0, fused_k, body, jnp.float32(0.0))
+
+    fq = jax.jit(_fused_q)
+    _sync(fq(state, qs))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        r = fq(state, qs)
+    _sync(r)
+    query_fused_s = (time.perf_counter() - t0) / (3 * fused_k)
+
     collapsed = float(_sync(state.collapsed_low.sum() + state.collapsed_high.sum()))
     total = float(_sync(state.count.sum()))
     return {
@@ -191,6 +208,7 @@ def _device_bench(
         "ingest_fused_per_s": round(fused_per_s, 1),
         "query_p50_s": round(float(np.percentile(lat, 50)), 6),
         "query_p99_s": round(float(np.percentile(lat, 99)), 6),
+        "query_fused_s": round(query_fused_s, 6),
         "collapsed_mass_frac": round(collapsed / max(total, 1.0), 6),
     }
 

@@ -217,3 +217,21 @@ def test_extreme_weights_do_not_poison_histogram():
     bins = np.asarray(got.bins_pos)
     assert np.isfinite(bins).all()
     np.testing.assert_allclose(bins[0].sum(), 3.4e38 + 127.0, rtol=1e-6)
+
+
+def test_query_survives_bin_mass_above_bf16_max():
+    """Review round 2: a finite bin mass above bf16 max (~3.3895e38) must not
+    round to inf inside the query's bf16-split cumsum -- quantiles must
+    still match the XLA engine (which scans in f32)."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128, key_offset=-64)
+    vals = np.full((128, 128), 2.0, np.float32)
+    w = np.ones((128, 128), np.float32)
+    w[:, 0] = 3.398e38  # finite f32, above bf16 max
+    state = kernels.add(
+        spec, init(spec, 128), jnp.asarray(vals), jnp.asarray(w), interpret=True
+    )
+    qs = jnp.asarray([0.25, 0.5, 0.999])
+    got = np.asarray(kernels.fused_quantile(spec, state, qs, interpret=True))
+    ref = np.asarray(xla_quantile(spec, state, qs))
+    assert np.isfinite(got).all(), got
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
