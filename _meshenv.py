@@ -2,11 +2,16 @@
 
 The host environment pins ``JAX_PLATFORMS`` to the single real TPU tunnel,
 so anything that needs an n-device mesh without n real chips (tests,
-``__graft_entry__.dryrun_multichip``) must force the virtual CPU platform.
-This module is deliberately jax-free so it can be imported before jax.
+``__graft_entry__.dryrun_multichip``, ``bench.py``'s distributed config)
+must force the virtual CPU platform.  This module is import-time jax-free
+so it can be imported before jax; ``force_cpu_if_child`` imports jax only
+when called.
 """
 
+import os
 import re
+import subprocess
+import sys
 
 _FORCE_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
 
@@ -20,3 +25,42 @@ def cpu_mesh_env(n_devices, env):
         flags + f" --xla_force_host_platform_device_count={int(n_devices)}"
     ).strip()
     return out
+
+
+def force_cpu_if_child(env_flag):
+    """In a CPU-mesh child process, force the jax runtime config to cpu.
+
+    The env vars from ``cpu_mesh_env`` are not enough on this host: the
+    axon sitecustomize hook re-registers the TPU platform at interpreter
+    startup, overriding ``JAX_PLATFORMS``, so the runtime config must be
+    forced too (same as tests/conftest.py).  Returns True when running as
+    the child (``env_flag`` set).
+    """
+    if not os.environ.get(env_flag):
+        return False
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True
+
+
+def run_cpu_mesh_child(argv, n_devices, env_flag, cwd, timeout=600, capture=False):
+    """Re-run ``argv`` in a child process on an ``n_devices`` virtual CPU mesh.
+
+    ``env_flag`` marks the child (its entry point should call
+    ``force_cpu_if_child`` and must NOT spawn again — the flag is the
+    recursion guard).  With ``capture`` the CompletedProcess is returned for
+    the caller to inspect; otherwise a nonzero child exit raises.
+    """
+    env = cpu_mesh_env(n_devices, os.environ)
+    env["PYTHONPATH"] = cwd + os.pathsep + env.get("PYTHONPATH", "")
+    env[env_flag] = "1"
+    return subprocess.run(
+        [sys.executable, *argv],
+        env=env,
+        cwd=cwd,
+        timeout=timeout,
+        capture_output=capture,
+        text=capture,
+        check=not capture,
+    )
