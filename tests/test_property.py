@@ -60,7 +60,7 @@ def test_accuracy_contract_any_stream(values):
 @settings(max_examples=50, deadline=None)
 @given(_streams, st.integers(min_value=0, max_value=2**32 - 1))
 def test_merge_equals_concatenation(values, seed):
-    rng = np.random.RandomState(seed % (2**32))
+    rng = np.random.RandomState(seed)
     parts = rng.randint(0, 3, size=len(values))
     sketches = [DDSketch(ALPHA) for _ in range(3)]
     for part, v in zip(parts, values):
