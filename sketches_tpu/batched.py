@@ -413,23 +413,9 @@ class BatchedDDSketch:
             )
         self.spec = spec
         self.state = init(spec, n_streams) if state is None else state
-        if engine not in ("auto", "xla", "pallas"):
-            raise ValueError(f"Unknown engine {engine!r}")
-        # 'auto': the Pallas kernels on TPU when the config qualifies, the
-        # portable XLA path otherwise.  'pallas' forces the kernels (in
-        # interpreter mode off-TPU -- for tests).
         from sketches_tpu import kernels
 
-        if engine == "pallas" and not kernels.supports(spec, n_streams):
-            raise ValueError(
-                "engine='pallas' requires f32 state and 128-aligned n_bins"
-                f" and n_streams; got {spec} with n_streams={n_streams}"
-            )
-        use_pallas = engine == "pallas" or (
-            engine == "auto"
-            and jax.default_backend() == "tpu"
-            and kernels.supports(spec, n_streams)
-        )
+        use_pallas, interpret = kernels.select_engine(spec, n_streams, engine)
         self.engine = "pallas" if use_pallas else "xla"
         # The XLA add stays available even on the Pallas engine: it takes
         # the non-128-aligned batch widths the kernels do not.
@@ -437,7 +423,6 @@ class BatchedDDSketch:
             functools.partial(add, spec), donate_argnums=(0,)
         )
         if use_pallas:
-            interpret = jax.default_backend() != "tpu"
             self._add_pallas = jax.jit(
                 functools.partial(kernels.add, spec, interpret=interpret),
                 donate_argnums=(0,),

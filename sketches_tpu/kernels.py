@@ -49,7 +49,7 @@ from jax.experimental.pallas import tpu as pltpu
 from sketches_tpu.batched import SketchSpec, SketchState
 from sketches_tpu.mapping import zero_threshold
 
-__all__ = ["supports", "ingest_histogram", "fused_quantile", "add"]
+__all__ = ["supports", "select_engine", "ingest_histogram", "fused_quantile", "add"]
 
 LO = 128  # lane width: low radix of the key split
 _BN = 128  # streams per block
@@ -65,6 +65,30 @@ def supports(spec: SketchSpec, n_streams: int, batch: Optional[int] = None) -> b
         and n_streams % _BN == 0
         and (batch is None or batch % _BS == 0)
     )
+
+
+def select_engine(spec: SketchSpec, n_streams: int, engine: str):
+    """Shared engine-selection policy -> (use_pallas, interpret).
+
+    'auto' picks the kernels on TPU when the configuration qualifies;
+    'pallas' forces them (interpreter mode off-TPU, for tests) and raises
+    on unsupported configurations; 'xla' always takes the portable path.
+    Both ``BatchedDDSketch`` and ``DistributedDDSketch`` route through
+    this so the two tiers can never diverge on the policy.
+    """
+    if engine not in ("auto", "xla", "pallas"):
+        raise ValueError(f"Unknown engine {engine!r}")
+    supported = supports(spec, n_streams)
+    if engine == "pallas" and not supported:
+        raise ValueError(
+            "engine='pallas' requires f32 state, 128-aligned n_bins, and a"
+            f" 128-aligned (per-shard) stream count; got {spec} with"
+            f" n_streams={n_streams}"
+        )
+    use_pallas = engine == "pallas" or (
+        engine == "auto" and jax.default_backend() == "tpu" and supported
+    )
+    return use_pallas, jax.default_backend() != "tpu"
 
 
 def _ingest_kernel(
@@ -127,10 +151,6 @@ def _ingest_kernel(
     lo = idx % LO
 
     bn, bs = v.shape
-    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, 2 * hi_size, bs), 1)
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, bs, LO), 2)
-    onehot_lo = (lo[:, :, None] == lo_iota).astype(jnp.bfloat16)  # [BN, BS, LO]
-
     dims = (((2,), (1,)), ((0,), (0,)))  # contract s; batch n
 
     @pl.when(j == 0)
@@ -151,15 +171,27 @@ def _ingest_kernel(
     # 3 x 8 mantissa bits >= f32's 24, so the split is exact) and the
     # histogram accumulates one bf16 matmul per term -- full f32 weight
     # precision at bf16 VMEM footprint, cheaper than a HIGHEST f32 matmul.
-    onehot_hi = (hi[:, None, :] == hi_iota).astype(jnp.bfloat16)  # [BN, 2HI, BS]
+    # Blocks wider than _BS process in _BS-value sub-chunks: one-hot
+    # operands are built (and die) per sub-chunk, so peak VMEM stays at the
+    # narrow-block level while the grid-iteration count still shrinks.
     n_terms = 3 if weighted else 1
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, 2 * hi_size, _BS), 1)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, _BS, LO), 2)
     c = jnp.zeros((bn, 2 * hi_size, LO), jnp.float32)
-    for part in _exact_bf16_terms(signed, n_terms):
-        # bf16 multiply by a 0/1 one-hot is exact.
-        a = onehot_hi * part[:, None, :]  # [BN, 2HI, BS] bf16
-        c = c + jax.lax.dot_general(
-            a, onehot_lo, dims, preferred_element_type=jnp.float32
-        )  # [BN, 2HI, LO]
+    for t in range(bs // _BS):
+        # lax.slice_in_dim, not mixed None+slice getitem: the latter takes
+        # jnp's gather path, which has no general Mosaic lowering.
+        hi_t = jax.lax.slice_in_dim(hi, t * _BS, (t + 1) * _BS, axis=1)
+        lo_t = jax.lax.slice_in_dim(lo, t * _BS, (t + 1) * _BS, axis=1)
+        w_t = jax.lax.slice_in_dim(signed, t * _BS, (t + 1) * _BS, axis=1)
+        onehot_hi = (hi_t[:, None, :] == hi_iota).astype(jnp.bfloat16)
+        onehot_lo = (lo_t[:, :, None] == lo_iota).astype(jnp.bfloat16)
+        for part in _exact_bf16_terms(w_t, n_terms):
+            # bf16 multiply by a 0/1 one-hot is exact.
+            a = onehot_hi * part[:, None, :]  # [BN, 2HI, _BS] bf16
+            c = c + jax.lax.dot_general(
+                a, onehot_lo, dims, preferred_element_type=jnp.float32
+            )  # [BN, 2HI, LO]
     c = c.reshape(bn, 2 * n_bins)
     hist_pos_ref[:] += c[:, :n_bins]
     hist_neg_ref[:] += c[:, n_bins:]
@@ -199,9 +231,10 @@ def ingest_histogram(
     [n_streams, 1] counter deltas, all from a single HBM read of the values.
     """
     n, s = values.shape
-    # Wider value chunks amortize the per-invocation cost of the batched
-    # histogram matmuls (measured +7% at 1M x 512 on v5e); gated on narrow
-    # bins so the doubled one-hot working set stays inside VMEM.
+    # Wider value blocks amortize grid-iteration overhead (measured +7% at
+    # 1M x 512 on v5e); the kernel builds its one-hots in _BS-wide
+    # sub-chunks so peak VMEM stays flat.  Narrow-bins gate kept
+    # conservatively: wide-bin configs carry bigger histogram accumulators.
     bs = 2 * _BS if s % (2 * _BS) == 0 and spec.n_bins <= 1024 else _BS
     grid = (n // _BN, s // bs)
     hist_shape = jax.ShapeDtypeStruct((n, spec.n_bins), jnp.float32)

@@ -47,6 +47,24 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map  # type: ignore
 
+
+def _shard_map_unchecked(f, mesh, in_specs, out_specs):
+    """shard_map with varying-axes checking off (pallas_call bodies).
+
+    The vma/rep checker cannot infer how a ``pallas_call``'s outputs vary
+    across mesh axes, so shard-mapped kernel bodies must opt out.
+    """
+    try:
+        return shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        return shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
 __all__ = [
     "default_mesh",
     "make_global_mesh",
@@ -158,6 +176,7 @@ class DistributedDDSketch:
         value_axis: Optional[str] = "values",
         stream_axis: Optional[str] = None,
         spec: Optional[SketchSpec] = None,
+        engine: str = "auto",
         **spec_kwargs,
     ):
         if spec is None:
@@ -177,14 +196,48 @@ class DistributedDDSketch:
         self.n_value_shards = mesh.shape[value_axis] if value_axis else 1
         self.n_streams = n_streams
 
+        # Engine selection mirrors BatchedDDSketch, but alignment is judged
+        # on the per-shard shapes the kernels actually see inside shard_map
+        # (on a v5e-8, each chip runs the Pallas engine on its own
+        # [n_streams/shards, n_bins] slice; engine='pallas' forces the
+        # kernels in interpreter mode off-TPU, for tests).
+        from sketches_tpu import kernels
+
+        n_stream_shards = max(mesh.shape[stream_axis] if stream_axis else 1, 1)
+        divisible = n_streams % n_stream_shards == 0
+        n_local_streams = n_streams // n_stream_shards
+        if engine == "pallas" and not divisible:
+            raise ValueError(
+                f"engine='pallas' needs a whole per-shard stream count:"
+                f" n_streams={n_streams} is not divisible by the"
+                f" {n_stream_shards}-way {stream_axis!r} mesh axis"
+            )
+        use_pallas, interpret = kernels.select_engine(
+            # 1 stream/shard is never kernel-eligible: disables the kernels
+            # for indivisible shardings without tripping the 'pallas' raise
+            # (pre-raised above with the real numbers).
+            spec, n_local_streams if divisible else 1, engine
+        )
+        self._engine_arg = engine
+        self.engine = "pallas" if use_pallas else "xla"
+
         state_spec = _state_pspec(value_axis, stream_axis)
         merged_spec = _merged_pspec(stream_axis)
         vspec = P(stream_axis, value_axis)
-        mesh_axes = tuple(n for n in (value_axis, stream_axis) if n)
+
+        def local_add(st, values, weights):
+            # Static per-trace choice: the Pallas engine when this call's
+            # shard-local batch width qualifies, the portable XLA scatter
+            # path otherwise.
+            if use_pallas and kernels.supports(
+                spec, n_local_streams, values.shape[-1]
+            ):
+                return kernels.add(spec, st, values, weights, interpret=interpret)
+            return add(spec, st, values, weights)
 
         def local_ingest(partials, values, weights):
             st = jax.tree.map(lambda x: x[0], partials)
-            st = add(spec, st, values, weights)
+            st = local_add(st, values, weights)
             return jax.tree.map(lambda x: x[None], st)
 
         def local_ingest_unweighted(partials, values):
@@ -198,19 +251,20 @@ class DistributedDDSketch:
                 st = psum_merge(st, value_axis)
             return st
 
+        smap = functools.partial(
+            _shard_map_unchecked if use_pallas else shard_map, mesh=mesh
+        )
         self._ingest = jax.jit(
-            shard_map(
+            smap(
                 local_ingest,
-                mesh=mesh,
                 in_specs=(state_spec, vspec, vspec),
                 out_specs=state_spec,
             ),
             donate_argnums=(0,),
         )
         self._ingest_unweighted = jax.jit(
-            shard_map(
+            smap(
                 local_ingest_unweighted,
-                mesh=mesh,
                 in_specs=(state_spec, vspec),
                 out_specs=state_spec,
             ),
@@ -221,7 +275,22 @@ class DistributedDDSketch:
                 fold, mesh=mesh, in_specs=(state_spec,), out_specs=merged_spec
             )
         )
-        self._quantile = jax.jit(functools.partial(quantile, spec))
+        if use_pallas:
+            # Per-shard fused query: each device runs the Pallas kernel on
+            # its own stream slice of the folded state (qs replicated).
+            def local_quantile(st, qs):
+                return kernels.fused_quantile(spec, st, qs, interpret=interpret)
+
+            self._quantile = jax.jit(
+                _shard_map_unchecked(
+                    local_quantile,
+                    mesh=mesh,
+                    in_specs=(merged_spec, P()),
+                    out_specs=P(stream_axis, None),
+                )
+            )
+        else:
+            self._quantile = jax.jit(functools.partial(quantile, spec))
         self._merge_partials = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
@@ -302,6 +371,9 @@ class DistributedDDSketch:
             self.n_streams,
             spec=self.spec,
             state=jax.tree.map(jnp.copy, self.merged_state()),
+            # Propagate an explicit user pin; 'auto' stays auto (the facade
+            # re-judges eligibility for the unsharded shape).
+            engine="xla" if self._engine_arg == "xla" else "auto",
         )
 
     # -- accessors ---------------------------------------------------------

@@ -188,3 +188,46 @@ def test_per_stream_1d_weights_match_batched_facade():
     dist = DistributedDDSketch(n_streams=2, spec=SPEC)
     dist.add(np.ones((2, 8), dtype=np.float32), weights=np.asarray([2.0, 3.0]))
     assert np.asarray(dist.count).tolist() == [16.0, 24.0]
+
+
+def test_pallas_engine_distributed_matches_xla():
+    """engine='pallas' (interpret off-TPU) inside shard_map: per-shard
+    kernel ingest + fused query must match the XLA engine bit-for-bit
+    (up to fp tolerance) on a 2-D (streams x values) mesh."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("streams", "values"))
+    kwargs = dict(
+        mesh=mesh, value_axis="values", stream_axis="streams", spec=SPEC
+    )
+    pal = DistributedDDSketch(n_streams=256, engine="pallas", **kwargs)
+    assert pal.engine == "pallas"
+    xla = DistributedDDSketch(n_streams=256, engine="xla", **kwargs)
+    values = _rows(Lognormal, 256, 512)  # 512/4 = 128-wide shards: kernel path
+    w = np.random.RandomState(0).uniform(0.5, 2.0, (256, 512)).astype(np.float32)
+    pal.add(values, w)
+    xla.add(values, w)
+    np.testing.assert_allclose(
+        np.asarray(pal.merged_state().bins_pos),
+        np.asarray(xla.merged_state().bins_pos),
+        rtol=1e-5, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pal.get_quantile_values(QS)),
+        np.asarray(xla.get_quantile_values(QS)),
+        rtol=1e-4,
+    )
+    # Misaligned widths fall back to the XLA scatter path per shard.
+    pal.add(np.ones((256, 4), np.float32))
+    xla.add(np.ones((256, 4), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(pal.count), np.asarray(xla.count), rtol=1e-6
+    )
+
+
+def test_pallas_engine_distributed_rejects_misaligned_shards():
+    with pytest.raises(ValueError, match="per-shard"):
+        DistributedDDSketch(
+            n_streams=8, engine="pallas", value_axis=None,
+            stream_axis="streams", spec=SPEC,
+        )
