@@ -301,20 +301,23 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     cum_pos = jnp.cumsum(state.bins_pos, axis=-1)  # [N, B]
     cum_neg = jnp.cumsum(state.bins_neg, axis=-1)
 
-    # Negative branch (reference: key_at_rank(neg_count - 1 - rank, lower=False)
-    # i.e. smallest key whose cumulative count >= r + 1 -> side='left').
+    # Rank selection as mask-counts over the monotone cumsums -- a fused
+    # broadcast-compare-reduce XLA vectorizes, where vmapped searchsorted
+    # lowers to serial gathers (measured 13.5x slower at 1M x 512 on v5e).
+    # Negative branch (reference: key_at_rank(neg_count - 1 - rank,
+    # lower=False), i.e. smallest key with cum >= r + 1 = #(cum < r + 1)).
     rev_rank = neg_count[:, None] - 1 - rank
-    idx_neg = jax.vmap(
-        lambda c, r: jnp.searchsorted(c, r + 1, side="left").astype(jnp.int32)
-    )(cum_neg, rev_rank)
+    idx_neg = (
+        (cum_neg[:, None, :] < rev_rank[:, :, None] + 1).sum(-1).astype(jnp.int32)
+    )
     idx_neg = jnp.clip(idx_neg, _first_occupied(state.bins_neg)[:, None],
                        _last_occupied(state.bins_neg)[:, None])
 
-    # Positive branch (lower=True -> smallest key with cum > r -> side='right').
+    # Positive branch (lower=True -> smallest key with cum > r = #(cum <= r)).
     pos_rank = rank - (state.zero_count + neg_count)[:, None]
-    idx_pos = jax.vmap(
-        lambda c, r: jnp.searchsorted(c, r, side="right").astype(jnp.int32)
-    )(cum_pos, pos_rank)
+    idx_pos = (
+        (cum_pos[:, None, :] <= pos_rank[:, :, None]).sum(-1).astype(jnp.int32)
+    )
     idx_pos = jnp.clip(idx_pos, _first_occupied(state.bins_pos)[:, None],
                        _last_occupied(state.bins_pos)[:, None])
 

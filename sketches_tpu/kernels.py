@@ -82,8 +82,8 @@ def select_engine(spec: SketchSpec, n_streams: int, engine: str):
     if engine == "pallas" and not supported:
         raise ValueError(
             "engine='pallas' requires f32 state, 128-aligned n_bins, and a"
-            f" 128-aligned (per-shard) stream count; got {spec} with"
-            f" n_streams={n_streams}"
+            " 128-aligned stream count (per-shard, when sharded over a"
+            f" mesh); got {spec} with n_streams={n_streams}"
         )
     use_pallas = engine == "pallas" or (
         engine == "auto" and jax.default_backend() == "tpu" and supported

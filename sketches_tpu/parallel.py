@@ -203,7 +203,7 @@ class DistributedDDSketch:
         # kernels in interpreter mode off-TPU, for tests).
         from sketches_tpu import kernels
 
-        n_stream_shards = max(mesh.shape[stream_axis] if stream_axis else 1, 1)
+        n_stream_shards = mesh.shape[stream_axis] if stream_axis else 1
         divisible = n_streams % n_stream_shards == 0
         n_local_streams = n_streams // n_stream_shards
         if engine == "pallas" and not divisible:
@@ -282,9 +282,8 @@ class DistributedDDSketch:
                 return kernels.fused_quantile(spec, st, qs, interpret=interpret)
 
             self._quantile = jax.jit(
-                _shard_map_unchecked(
+                smap(
                     local_quantile,
-                    mesh=mesh,
                     in_specs=(merged_spec, P()),
                     out_specs=P(stream_axis, None),
                 )
