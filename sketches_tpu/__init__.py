@@ -10,8 +10,8 @@ Two execution tiers:
   for device-path parity tests.
 * **Device tier** -- ``BatchedDDSketch`` / ``sketches_tpu.batched``:
   struct-of-arrays ``[n_streams, n_bins]`` state living on TPU; jit'd ingest
-  (scatter-add), fused quantile queries (cumsum + searchsorted, or the Pallas
-  kernel), ``merge`` as ``lax.psum`` over a device mesh.
+  (scatter-add), fused quantile queries (cumsum + mask-count rank selection,
+  or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
 from sketches_tpu.ddsketch import (
