@@ -1,0 +1,30 @@
+"""The examples/ scripts must stay runnable -- they are executable docs."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+@pytest.mark.parametrize(
+    "script", ["latency_monitoring.py", "distributed_mesh.py"]
+)
+def test_example_runs_clean(script):
+    env = dict(os.environ)
+    # Run on the CPU platform regardless of the host's pinned backend; the
+    # scripts self-provision their mesh when JAX_PLATFORMS is unset.
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, script)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert out.returncode == 0, out.stdout + "\n" + out.stderr

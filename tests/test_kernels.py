@@ -254,3 +254,17 @@ def test_wide_chunk_branch_parity():
                 np.asarray(getattr(got, f)), np.asarray(getattr(ref, f)),
                 rtol=1e-5, atol=1e-4, err_msg=f,
             )
+
+
+def test_wide_stream_block_query_parity():
+    """n_streams % 256 == 0 with n_bins <= 1024 takes the 2*_BN query block;
+    quantiles must match the XLA engine."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    vals = np.random.RandomState(9).lognormal(0, 1.2, (256, 128)).astype(np.float32)
+    vals[:, ::5] *= -1.0
+    vals[0, :] = 0.0
+    state = kernels.add(spec, init(spec, 256), jnp.asarray(vals), interpret=True)
+    qs = jnp.asarray([0.0, 0.25, 0.5, 0.99, 1.0])
+    got = np.asarray(kernels.fused_quantile(spec, state, qs, interpret=True))
+    ref = np.asarray(xla_quantile(spec, state, qs))
+    np.testing.assert_allclose(got, ref, rtol=1e-4, equal_nan=True)
