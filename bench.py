@@ -30,6 +30,7 @@ Methodology notes:
 from __future__ import annotations
 
 import argparse
+import os
 import contextlib
 import functools
 import json
@@ -260,7 +261,6 @@ def bench_distributed(profile: bool):
 
     n_devices = len(jax.devices())
     if n_devices < 2:
-        import os
 
         result = {
             "devices_measured": n_devices,
@@ -374,6 +374,19 @@ def main():
     from _meshenv import force_cpu_if_child
 
     import jax
+
+    # Persistent compilation cache: first-run compiles through the tunnel
+    # cost 20-40 s per jit and dominate the benchmark's wall clock; cached
+    # repeat runs (e.g. the driver's end-of-round invocation) skip them.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # pragma: no cover - older jax without the knobs
+        pass
 
     force_cpu_if_child("_BENCH_CPU_CHILD")
     if args.c3_only:

@@ -14,7 +14,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ:
+_SELF_PROVISIONED = __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ
+if _SELF_PROVISIONED:
     # Provision a virtual 8-device CPU mesh when run standalone.
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
@@ -28,7 +29,10 @@ from jax.sharding import Mesh
 
 
 def main():
-    if jax.default_backend() == "cpu":
+    if _SELF_PROVISIONED:
+        # Env vars alone are not enough on hosts whose sitecustomize hook
+        # re-registers an accelerator platform at interpreter startup; the
+        # runtime config must be forced too.
         jax.config.update("jax_platforms", "cpu")
     from sketches_tpu.parallel import DistributedDDSketch
 

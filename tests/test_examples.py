@@ -28,3 +28,7 @@ def test_example_runs_clean(script):
         env=env,
     )
     assert out.returncode == 0, out.stdout + "\n" + out.stderr
+    if script == "distributed_mesh.py":
+        # The example must actually demonstrate a multi-device mesh: its
+        # self-provisioning forces the 8-device virtual CPU platform.
+        assert "mesh: 8 x cpu" in out.stdout, out.stdout
