@@ -22,11 +22,28 @@
 
 namespace {
 
+// Mirrors sketches_tpu/mapping.py's registry order (the Python oracle):
+// the host pre-aggregator must key values identically to whichever mapping
+// the device batch it feeds was built with -- including the cubic mapping
+// the flagship 1M-stream config uses (VERDICT r2 item 5).
+enum MappingKind {
+  kLogarithmic = 0,
+  kLinearInterpolated = 1,
+  kCubicInterpolated = 2,
+};
+
+// Cubic-interpolation coefficients (mapping.py . CubicallyInterpolatedMapping).
+constexpr double kCubicA = 6.0 / 35.0;
+constexpr double kCubicB = -3.0 / 5.0;
+constexpr double kCubicC = 10.0 / 7.0;
+constexpr int kNewtonIters = 5;
+
 struct Sketch {
   int n_bins;
   int key_offset;
+  int mapping;        // MappingKind
   double gamma;
-  double multiplier;  // 1 / ln(gamma)
+  double multiplier;  // 1 / ln(gamma), cubic-scaled by 7/10 (see create)
   std::vector<double> pos;
   std::vector<double> neg;
   double zero_count = 0.0;
@@ -37,6 +54,61 @@ struct Sketch {
   double collapsed_low = 0.0;
   double collapsed_high = 0.0;
 };
+
+inline double cubic(double s) {
+  return ((kCubicA * s + kCubicB) * s + kCubicC) * s;
+}
+
+inline double cubic_deriv(double s) {
+  return (3.0 * kCubicA * s + 2.0 * kCubicB) * s + kCubicC;
+}
+
+// log_gamma(v) for v > 0: the (possibly approximated) log the key rounds up
+// from.  Semantics are scalar-path mapping.py: frexp mantissa in [0.5, 1).
+inline double log_gamma(const Sketch& s, double v) {
+  switch (s.mapping) {
+    case kLinearInterpolated: {
+      int e;
+      const double m = std::frexp(v, &e);
+      return (2.0 * m - 1.0 + (e - 1)) * s.multiplier;
+    }
+    case kCubicInterpolated: {
+      int e;
+      const double m = std::frexp(v, &e);
+      return (cubic(2.0 * m - 1.0) + (e - 1)) * s.multiplier;
+    }
+    default:
+      return std::log(v) * s.multiplier;
+  }
+}
+
+// Exact inverse of log_gamma (mapping.py _pow_gamma): the bucket decode.
+inline double pow_gamma(const Sketch& s, double x) {
+  const double v = x / s.multiplier;
+  switch (s.mapping) {
+    case kLinearInterpolated: {
+      const double e = std::floor(v);
+      const double m = (v - e + 1.0) / 2.0;
+      return std::ldexp(m, static_cast<int>(e) + 1);
+    }
+    case kCubicInterpolated: {
+      const double e = std::floor(v);
+      const double rem = v - e;
+      double t = rem;  // f(t) ~= t to first order; Newton polishes
+      for (int i = 0; i < kNewtonIters; ++i) {
+        t = t - (cubic(t) - rem) / cubic_deriv(t);
+      }
+      return std::ldexp((t + 1.0) / 2.0, static_cast<int>(e) + 1);
+    }
+    default:
+      return std::exp(v);
+  }
+}
+
+// Bucket representative: pow_gamma scaled to the alpha-midpoint.
+inline double key_value(const Sketch& s, int key) {
+  return pow_gamma(s, static_cast<double>(key)) * (2.0 / (1.0 + s.gamma));
+}
 
 // Clamp in DOUBLE space before any int cast: log(inf) and huge finite
 // values overflow int, and an out-of-range double->int cast is UB (x86
@@ -59,13 +131,13 @@ inline void add_one(Sketch& s, double v, double w) {
   if (w <= 0.0) return;  // inert padding, matching the device tier
   if (v > 0.0) {
     bool low = false, high = false;
-    int key = clamp_key(s, std::ceil(std::log(v) * s.multiplier), &low, &high);
+    int key = clamp_key(s, std::ceil(log_gamma(s, v)), &low, &high);
     s.pos[key - s.key_offset] += w;
     if (low) s.collapsed_low += w;
     if (high) s.collapsed_high += w;
   } else if (v < 0.0) {
     bool low = false, high = false;
-    int key = clamp_key(s, std::ceil(std::log(-v) * s.multiplier), &low, &high);
+    int key = clamp_key(s, std::ceil(log_gamma(s, -v)), &low, &high);
     s.neg[key - s.key_offset] += w;
     if (low) s.collapsed_low += w;
     if (high) s.collapsed_high += w;
@@ -82,17 +154,25 @@ inline void add_one(Sketch& s, double v, double w) {
 
 extern "C" {
 
-void* sketch_create(double relative_accuracy, int n_bins, int key_offset) {
-  if (relative_accuracy <= 0.0 || relative_accuracy >= 1.0 || n_bins < 2) {
+void* sketch_create(double relative_accuracy, int n_bins, int key_offset,
+                    int mapping_kind) {
+  if (relative_accuracy <= 0.0 || relative_accuracy >= 1.0 || n_bins < 2 ||
+      mapping_kind < kLogarithmic || mapping_kind > kCubicInterpolated) {
     return nullptr;
   }
   auto* s = new Sketch();
   s->n_bins = n_bins;
   s->key_offset = key_offset;
+  s->mapping = mapping_kind;
   const double mantissa =
       2.0 * relative_accuracy / (1.0 - relative_accuracy);
   s->gamma = 1.0 + mantissa;
   s->multiplier = 1.0 / std::log1p(mantissa);
+  if (mapping_kind == kCubicInterpolated) {
+    // Bucket-width guarantee for the cubic log2 approximation
+    // (mapping.py: multiplier *= 7/10 -- the f'(0) * ln2 derivative bound).
+    s->multiplier *= 7.0 / 10.0;
+  }
   s->pos.assign(n_bins, 0.0);
   s->neg.assign(n_bins, 0.0);
   return s;
@@ -124,7 +204,6 @@ double sketch_quantile(void* handle, double q) {
   double neg_count = 0.0;
   for (double b : s.neg) neg_count += b;
   const double rank = q * (s.count - 1.0);
-  const double rep = 2.0 / (1.0 + s.gamma);
   if (rank < neg_count) {
     // lower=False walk from the top of the negative store.
     const double target = neg_count - 1.0 - rank;
@@ -132,10 +211,10 @@ double sketch_quantile(void* handle, double q) {
     for (int i = 0; i < s.n_bins; ++i) {
       running += s.neg[i];
       if (running >= target + 1.0) {
-        return -std::exp((i + s.key_offset) / s.multiplier) * rep;
+        return -key_value(s, i + s.key_offset);
       }
     }
-    return -std::exp((s.n_bins - 1 + s.key_offset) / s.multiplier) * rep;
+    return -key_value(s, s.n_bins - 1 + s.key_offset);
   }
   if (rank < neg_count + s.zero_count) return 0.0;
   const double target = rank - neg_count - s.zero_count;
@@ -143,10 +222,10 @@ double sketch_quantile(void* handle, double q) {
   for (int i = 0; i < s.n_bins; ++i) {
     running += s.pos[i];
     if (running > target) {
-      return std::exp((i + s.key_offset) / s.multiplier) * rep;
+      return key_value(s, i + s.key_offset);
     }
   }
-  return std::exp((s.n_bins - 1 + s.key_offset) / s.multiplier) * rep;
+  return key_value(s, s.n_bins - 1 + s.key_offset);
 }
 
 // Fold `other` into `handle`; both must share (gamma, n_bins, key_offset) --
@@ -154,7 +233,10 @@ double sketch_quantile(void* handle, double q) {
 int sketch_merge(void* handle, const void* other) {
   Sketch& a = *static_cast<Sketch*>(handle);
   const Sketch& b = *static_cast<const Sketch*>(other);
-  if (a.n_bins != b.n_bins || a.key_offset != b.key_offset) return -1;
+  if (a.n_bins != b.n_bins || a.key_offset != b.key_offset ||
+      a.mapping != b.mapping) {
+    return -1;
+  }
   for (int i = 0; i < a.n_bins; ++i) {
     a.pos[i] += b.pos[i];
     a.neg[i] += b.neg[i];

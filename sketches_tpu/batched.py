@@ -295,6 +295,8 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     NaN (the array-world stand-in for the reference's ``None``).
     """
     qs = jnp.atleast_1d(jnp.asarray(qs, spec.dtype))
+    if qs.shape[0] == 0:  # empty quantile list: [N, 0], nothing to select
+        return jnp.zeros((state.n_streams, 0), spec.dtype)
     neg_count = state.bins_neg.sum(-1)  # [N]
     count = state.count
     rank = qs[None, :] * (count[:, None] - 1)  # [N, Q]
@@ -305,19 +307,33 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     # Rank selection as mask-counts over the monotone cumsums -- a fused
     # broadcast-compare-reduce XLA vectorizes, where vmapped searchsorted
     # lowers to serial gathers (measured 13.5x slower at 1M x 512 on v5e).
+    # The Q axis unrolls as a static Python loop: peak memory stays at the
+    # cumsum's O(N*B) instead of an O(N*Q*B) boolean intermediate, which on
+    # backends that fail to fuse the 3-D compare+reduce (large-N CPU runs)
+    # would materialize gigabytes (ADVICE r2).  Q is small (typically <= 8),
+    # so the unrolled reduces cost the same as the broadcast form.
     # Negative branch (reference: key_at_rank(neg_count - 1 - rank,
     # lower=False), i.e. smallest key with cum >= r + 1 = #(cum < r + 1)).
     rev_rank = neg_count[:, None] - 1 - rank
-    idx_neg = (
-        (cum_neg[:, None, :] < rev_rank[:, :, None] + 1).sum(-1).astype(jnp.int32)
+    q_total = rank.shape[1]
+    idx_neg = jnp.stack(
+        [
+            (cum_neg < rev_rank[:, qi : qi + 1] + 1).sum(-1).astype(jnp.int32)
+            for qi in range(q_total)
+        ],
+        axis=1,
     )
     idx_neg = jnp.clip(idx_neg, _first_occupied(state.bins_neg)[:, None],
                        _last_occupied(state.bins_neg)[:, None])
 
     # Positive branch (lower=True -> smallest key with cum > r = #(cum <= r)).
     pos_rank = rank - (state.zero_count + neg_count)[:, None]
-    idx_pos = (
-        (cum_pos[:, None, :] <= pos_rank[:, :, None]).sum(-1).astype(jnp.int32)
+    idx_pos = jnp.stack(
+        [
+            (cum_pos <= pos_rank[:, qi : qi + 1]).sum(-1).astype(jnp.int32)
+            for qi in range(q_total)
+        ],
+        axis=1,
     )
     idx_pos = jnp.clip(idx_pos, _first_occupied(state.bins_pos)[:, None],
                        _last_occupied(state.bins_pos)[:, None])

@@ -414,6 +414,10 @@ class DDSketch(BaseDDSketch):
         cls,
         relative_accuracy: typing.Optional[float] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        n_bins: typing.Optional[int] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if backend == "jax":
             if cls is not DDSketch:
@@ -421,15 +425,25 @@ class DDSketch(BaseDDSketch):
                     f"backend='jax' is not inherited by subclass {cls.__name__};"
                     " construct JaxDDSketch directly"
                 )
-            return JaxDDSketch(relative_accuracy)
+            return JaxDDSketch(
+                relative_accuracy,
+                n_bins=n_bins,
+                mapping=mapping or "logarithmic",
+                key_offset=key_offset,
+            )
         if backend != "py":
             raise ValueError(f"Unknown backend {backend!r}")
+        _reject_jax_only_kwargs(mapping=mapping, n_bins=n_bins, key_offset=key_offset)
         return super().__new__(cls)
 
     def __init__(
         self,
         relative_accuracy: typing.Optional[float] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        n_bins: typing.Optional[int] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
@@ -440,9 +454,23 @@ class DDSketch(BaseDDSketch):
         )
 
 
+def _reject_jax_only_kwargs(**kwargs) -> None:
+    """The py presets are reference-shaped (LogarithmicMapping + the preset's
+    store class); the device-tier knobs only apply to ``backend='jax'``.
+    Compose ``BaseDDSketch`` directly for a non-default pure-Python sketch."""
+    passed = [k for k, v in kwargs.items() if v is not None]
+    if passed:
+        raise ValueError(
+            f"{', '.join(passed)} only apply to backend='jax'; for a custom"
+            " pure-Python sketch compose BaseDDSketch(mapping=..., store=...)"
+        )
+
+
 def _jax_collapsing_sketch(
     relative_accuracy: typing.Optional[float],
     bin_limit: typing.Optional[int],
+    mapping: typing.Optional[str] = None,
+    key_offset: typing.Optional[int] = None,
 ) -> "JaxDDSketch":
     """The jax backend for both collapsing presets.
 
@@ -457,7 +485,12 @@ def _jax_collapsing_sketch(
     # the default, same as negative values: the device window needs >= 2 bins.
     if bin_limit is None or bin_limit < 2:
         bin_limit = DEFAULT_BIN_LIMIT
-    return JaxDDSketch(relative_accuracy, n_bins=bin_limit)
+    return JaxDDSketch(
+        relative_accuracy,
+        n_bins=bin_limit,
+        mapping=mapping or "logarithmic",
+        key_offset=key_offset,
+    )
 
 
 class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
@@ -473,6 +506,9 @@ class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if backend == "jax":
             if cls is not LogCollapsingLowestDenseDDSketch:
@@ -480,9 +516,12 @@ class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
                     f"backend='jax' is not inherited by subclass {cls.__name__};"
                     " construct JaxDDSketch directly"
                 )
-            return _jax_collapsing_sketch(relative_accuracy, bin_limit)
+            return _jax_collapsing_sketch(
+                relative_accuracy, bin_limit, mapping, key_offset
+            )
         if backend != "py":
             raise ValueError(f"Unknown backend {backend!r}")
+        _reject_jax_only_kwargs(mapping=mapping, key_offset=key_offset)
         return super().__new__(cls)
 
     def __init__(
@@ -490,6 +529,9 @@ class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC
@@ -515,6 +557,9 @@ class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if backend == "jax":
             if cls is not LogCollapsingHighestDenseDDSketch:
@@ -522,9 +567,12 @@ class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
                     f"backend='jax' is not inherited by subclass {cls.__name__};"
                     " construct JaxDDSketch directly"
                 )
-            return _jax_collapsing_sketch(relative_accuracy, bin_limit)
+            return _jax_collapsing_sketch(
+                relative_accuracy, bin_limit, mapping, key_offset
+            )
         if backend != "py":
             raise ValueError(f"Unknown backend {backend!r}")
+        _reject_jax_only_kwargs(mapping=mapping, key_offset=key_offset)
         return super().__new__(cls)
 
     def __init__(
@@ -532,6 +580,9 @@ class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
         relative_accuracy: typing.Optional[float] = None,
         bin_limit: typing.Optional[int] = None,
         backend: str = "py",
+        *,
+        mapping: typing.Optional[str] = None,
+        key_offset: typing.Optional[int] = None,
     ):
         if relative_accuracy is None:
             relative_accuracy = DEFAULT_REL_ACC

@@ -464,6 +464,8 @@ def fused_quantile(
     n = state.n_streams
     qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
     q_total = qs.shape[0]
+    if q_total == 0:  # empty quantile list: nothing to launch
+        return jnp.zeros((n, 0), jnp.float32)
     bn = _wide_block(n, spec.n_bins, _BN)
     bins_spec = pl.BlockSpec(
         (bn, spec.n_bins), lambda i: (i, 0), memory_space=pltpu.VMEM

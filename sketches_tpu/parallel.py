@@ -167,6 +167,14 @@ class DistributedDDSketch:
 
     State layout: a stacked ``[n_value_shards, n_streams, n_bins]`` pytree,
     sharded ``P(value_axis, stream_axis, None)``.  Ingest donates it.
+
+    Engine note: like ``BatchedDDSketch``, the Pallas engine requires each
+    *call's* per-shard value-batch width to be 128-aligned; an ``add`` whose
+    width does not qualify silently takes the portable XLA scatter path for
+    that call, even under ``engine='pallas'`` (which pins the *eligible*
+    calls to the kernels; it cannot make an unaligned width eligible).  Pad
+    ragged batches with ``weights=0`` entries to keep every call on the
+    kernels (ADVICE r2).
     """
 
     def __init__(

@@ -302,3 +302,66 @@ def test_subclass_jax_backend_is_loud_and_degenerate_bin_limit_defaults():
         REL_ACC, bin_limit=0, backend="jax"
     )
     assert sk._spec.n_bins == 2048  # falls back to the default window
+
+
+@pytest.mark.parametrize(
+    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+)
+def test_ddsketch_jax_backend_full_spec_seam(mapping):
+    # VERDICT round 2 item 6: the DDSketch(...) facade itself accepts the
+    # full device configuration -- mapping, n_bins, key_offset -- without
+    # forcing users onto JaxDDSketch.
+    sk = DDSketch(
+        REL_ACC, backend="jax", mapping=mapping, n_bins=512, key_offset=-100
+    )
+    assert isinstance(sk, JaxDDSketch)
+    assert sk._spec.mapping_name == mapping
+    assert sk._spec.n_bins == 512
+    assert sk._spec.key_offset == -100
+    dataset = Normal(3000)
+    for v in dataset:
+        sk.add(v)
+    for q in QS:
+        exact = dataset.quantile(q)
+        got = sk.get_quantile_value(q)
+        assert abs(got - exact) <= REL_ACC * abs(exact) + 1e-5, (mapping, q)
+
+
+@pytest.mark.parametrize(
+    "cls_name",
+    ["LogCollapsingLowestDenseDDSketch", "LogCollapsingHighestDenseDDSketch"],
+)
+def test_collapsing_presets_jax_backend_full_spec_seam(cls_name):
+    import sketches_tpu
+
+    cls = getattr(sketches_tpu, cls_name)
+    sk = cls(
+        REL_ACC,
+        bin_limit=256,
+        backend="jax",
+        mapping="cubic_interpolated",
+        key_offset=-32,
+    )
+    assert isinstance(sk, JaxDDSketch)
+    assert sk._spec.mapping_name == "cubic_interpolated"
+    assert sk._spec.n_bins == 256
+    assert sk._spec.key_offset == -32
+    sk.add(1.0)
+    assert sk.get_quantile_value(0.5) == pytest.approx(1.0, rel=REL_ACC)
+
+
+def test_jax_only_kwargs_rejected_on_py_backend():
+    # The py presets stay reference-shaped: device-tier knobs on backend='py'
+    # raise instead of being silently ignored.
+    import sketches_tpu
+
+    with pytest.raises(ValueError, match="backend='jax'"):
+        DDSketch(REL_ACC, mapping="cubic_interpolated")
+    with pytest.raises(ValueError, match="backend='jax'"):
+        DDSketch(REL_ACC, n_bins=512)
+    with pytest.raises(ValueError, match="backend='jax'"):
+        sketches_tpu.LogCollapsingLowestDenseDDSketch(REL_ACC, key_offset=-5)
+    with pytest.raises(ValueError, match="backend='jax'"):
+        sketches_tpu.LogCollapsingHighestDenseDDSketch(
+            REL_ACC, mapping="logarithmic"
+        )

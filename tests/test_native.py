@@ -115,3 +115,76 @@ def test_nan_goes_to_zero_bucket():
     sk.add_batch(np.asarray([1.0, np.nan, 5.0]))
     assert sk.count == 3.0
     assert sk.zero_count == 1.0
+
+
+ALL_MAPPINGS = ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+
+
+@pytest.mark.parametrize("mapping", ALL_MAPPINGS)
+def test_key_parity_with_python_mapping(mapping):
+    # VERDICT r2 item 5: the engine must key values exactly like the Python
+    # scalar path (both compute in f64), for every mapping.  Single-value
+    # sketches expose the raw key as the one occupied bin.
+    from sketches_tpu.mapping import mapping_from_name
+
+    m = mapping_from_name(mapping, REL_ACC)
+    for v in [1e-9, 0.004, 0.37, 1.0, 1.5, 2.0, 97.3, 1e4, 7.7e8]:
+        sk = NativeDDSketch(REL_ACC, n_bins=8192, key_offset=-4096, mapping=mapping)
+        sk.add(v)
+        pos, _ = sk.bins()
+        (idx,) = np.nonzero(pos)
+        assert int(idx[0]) - 4096 == m.key(v), (mapping, v)
+
+
+@pytest.mark.parametrize("mapping", ALL_MAPPINGS)
+def test_accuracy_contract_all_mappings(mapping):
+    dataset = Normal(2000)
+    sk = NativeDDSketch(REL_ACC, mapping=mapping)
+    sk.add_batch(np.asarray(list(dataset)))
+    for q in [0.0, 0.25, 0.5, 0.75, 0.99, 1.0]:
+        exact = dataset.quantile(q)
+        got = sk.get_quantile_value(q)
+        assert abs(got - exact) <= REL_ACC * abs(exact) + 1e-9, (mapping, q)
+
+
+@pytest.mark.parametrize("mapping", ALL_MAPPINGS)
+def test_device_state_roundtrip_all_mappings(mapping):
+    # The host pre-aggregator must feed (and drain) device batches of any
+    # mapping -- including the flagship config's cubic (VERDICT r2 item 5).
+    spec = SketchSpec(
+        relative_accuracy=REL_ACC, n_bins=2048, mapping_name=mapping
+    )
+    data = np.asarray(list(Normal(1000)), np.float32)
+    native = NativeDDSketch(
+        REL_ACC, n_bins=spec.n_bins, key_offset=spec.key_offset, mapping=mapping
+    )
+    native.add_batch(data)
+    state = native.to_state()
+    for q in (0.05, 0.5, 0.95):
+        # Device query over native-built bins agrees with the native query
+        # within fp tolerance (same bins, same decode semantics).
+        np.testing.assert_allclose(
+            float(get_quantile_value(spec, state, q)[0]),
+            native.get_quantile_value(q),
+            rtol=1e-4,
+        )
+    back = NativeDDSketch.from_state(spec, state)
+    assert back.mapping == mapping
+    assert back.count == pytest.approx(native.count)
+    assert back.get_quantile_value(0.5) == pytest.approx(
+        native.get_quantile_value(0.5), rel=1e-5
+    )
+
+
+def test_mapping_mismatch_not_mergeable():
+    from sketches_tpu import UnequalSketchParametersError
+
+    a = NativeDDSketch(REL_ACC, mapping="logarithmic")
+    b = NativeDDSketch(REL_ACC, mapping="cubic_interpolated")
+    a.add(1.0)
+    b.add(1.0)
+    assert not a.mergeable(b)
+    with pytest.raises(UnequalSketchParametersError):
+        a.merge(b)
+    with pytest.raises(ValueError, match="mapping"):
+        NativeDDSketch(REL_ACC, mapping="quartic")
