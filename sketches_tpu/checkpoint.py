@@ -60,9 +60,16 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             key_offset=meta["key_offset"],
             dtype=jnp.dtype(meta["dtype"]),
         )
-        state = SketchState(
-            **{name: jnp.asarray(data[name]) for name in _FIELDS}
-        )
+        arrays = {
+            name: jnp.asarray(data[name]) for name in _FIELDS if name in data
+        }
+        # Pre-adaptive-window checkpoints (round <= 2) carry no per-stream
+        # offsets: every stream was on the spec default.
+        if "key_offset" not in arrays:
+            arrays["key_offset"] = jnp.full(
+                arrays["count"].shape, spec.key_offset, dtype=jnp.int32
+            )
+        state = SketchState(**arrays)
     return spec, state
 
 

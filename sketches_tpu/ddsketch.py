@@ -238,16 +238,30 @@ class JaxDDSketch(BaseDDSketch):
     @staticmethod
     @functools.lru_cache(maxsize=None)
     def _jitted_ops(spec):
-        """One set of compiled (add, quantile, merge) per spec, shared by
-        every instance (and every ``copy()``) with that spec."""
+        """One set of compiled (add, first_add, quantile, merge) per spec,
+        shared by every instance (and every ``copy()``) with that spec.
+        ``first_add`` centers the window on the first chunk's median key
+        before ingesting (skipped when the user pinned ``key_offset``);
+        ``merge`` realigns the operand's window onto self's, so sketches
+        whose adaptive windows drifted apart stay mergeable."""
         import jax
 
         from sketches_tpu import batched
 
+        def _first_add(st, values, weights):
+            st = batched.recenter(
+                spec, st, batched.auto_offset(spec, st, values)
+            )
+            return batched.add(spec, st, values, weights)
+
         return (
             jax.jit(functools.partial(batched.add, spec), donate_argnums=(0,)),
+            jax.jit(_first_add, donate_argnums=(0,)),
             jax.jit(functools.partial(batched.get_quantile_value, spec)),
-            jax.jit(functools.partial(batched.merge, spec), donate_argnums=(0,)),
+            jax.jit(
+                functools.partial(batched.merge_aligned, spec),
+                donate_argnums=(0,),
+            ),
         )
 
     def __init__(
@@ -271,9 +285,15 @@ class JaxDDSketch(BaseDDSketch):
         self._mapping = mapping_from_name(mapping, relative_accuracy)
         self._relative_accuracy = relative_accuracy
         self._state = batched.init(self._spec, 1)
-        self._flush_fn, self._quantile_fn, self._merge_fn = self._jitted_ops(
-            self._spec
-        )
+        (
+            self._flush_fn,
+            self._first_flush_fn,
+            self._quantile_fn,
+            self._merge_fn,
+        ) = self._jitted_ops(self._spec)
+        # First flush centers the window on the data unless the caller
+        # pinned it (an explicit key_offset is a deliberate window choice).
+        self._auto_center_pending = key_offset is None
         self._pending_vals: list = []
         self._pending_weights: list = []
         self._host_cache: typing.Optional[BaseDDSketch] = None
@@ -317,7 +337,11 @@ class JaxDDSketch(BaseDDSketch):
             weights = np.zeros((1, self._FLUSH_CHUNK), np.float32)
             values[0, : len(chunk_v)] = chunk_v
             weights[0, : len(chunk_w)] = chunk_w
-            self._state = self._flush_fn(self._state, values, weights)
+            if self._auto_center_pending:
+                self._auto_center_pending = False
+                self._state = self._first_flush_fn(self._state, values, weights)
+            else:
+                self._state = self._flush_fn(self._state, values, weights)
 
     def get_quantile_value(self, quantile: float) -> typing.Optional[float]:
         if quantile < 0 or quantile > 1 or self._count == 0:
@@ -355,6 +379,9 @@ class JaxDDSketch(BaseDDSketch):
 
             other_state = from_host_sketches(self._spec, [sketch])
         self._state = self._merge_fn(self._state, other_state)
+        # The merge populated the device state; a still-pending auto-center
+        # on the next flush would recenter away from the merged mass.
+        self._auto_center_pending = False
         self._host_cache = None
         self._zero_count += sketch._zero_count
         self._count += sketch._count
@@ -373,6 +400,7 @@ class JaxDDSketch(BaseDDSketch):
             key_offset=self._spec.key_offset,
         )
         new._state = jax.tree.map(jax.numpy.copy, self._state)
+        new._auto_center_pending = self._auto_center_pending
         new._zero_count = self._zero_count
         new._count = self._count
         new._sum = self._sum

@@ -105,6 +105,7 @@ def select_engine(spec: SketchSpec, n_streams: int, engine: str):
 def _ingest_kernel(
     values_ref,
     weights_ref,
+    key_offset_ref,
     hist_pos_ref,
     hist_neg_ref,
     zero_ref,
@@ -141,8 +142,10 @@ def _ingest_kernel(
     is_zero = jnp.logical_not(jnp.logical_or(is_pos, is_neg))
     absv = jnp.where(is_zero, 1.0, jnp.abs(v))
     keys = spec.mapping.key_array(absv)
-    key_lo = jnp.int32(spec.key_offset)
-    key_hi = jnp.int32(spec.key_offset + n_bins - 1)
+    # Per-stream window low edge ([BN, 1] i32 column from the state),
+    # broadcast against the value lanes -- the adaptive-window seam.
+    key_lo = key_offset_ref[:]
+    key_hi = key_lo + jnp.int32(n_bins - 1)
     clamped_low = keys < key_lo
     clamped_high = keys > key_hi
     idx = jnp.clip(keys, key_lo, key_hi) - key_lo
@@ -230,13 +233,15 @@ def ingest_histogram(
     spec: SketchSpec,
     values: jax.Array,
     weights: jax.Array,
+    key_offset: jax.Array,
     *,
     weighted: bool = True,
     interpret: bool = False,
 ) -> Tuple[jax.Array, ...]:
     """One fused pass over a value batch -> histograms + scalar bookkeeping.
 
-    ``values``/``weights``: [n_streams, batch] f32.  Returns
+    ``values``/``weights``: [n_streams, batch] f32; ``key_offset``:
+    [n_streams] i32 per-stream window edges (``state.key_offset``).  Returns
     ``(hist_pos, hist_neg, zero, count, sum, min, max, clow, chigh)`` --
     the two [n_streams, n_bins] histograms of this batch plus the per-stream
     [n_streams, 1] counter deltas, all from a single HBM read of the values.
@@ -258,11 +263,12 @@ def ingest_histogram(
         in_specs=[
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+            col_spec,
         ],
         out_specs=[hist_spec, hist_spec] + [col_spec] * 7,
         out_shape=[hist_shape, hist_shape] + [col_shape] * 7,
         interpret=interpret,
-    )(values, weights)
+    )(values, weights, key_offset[:, None].astype(jnp.int32))
 
 
 _BF16_MAX = 3.3895314e38  # plain float: jnp constants would be captured consts in pallas
@@ -352,7 +358,7 @@ def _first_last_occupied(x: jax.Array):
     return first, last
 
 
-def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, qs):
+def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, key_lo, qs):
     """The rank-selection math shared by the standalone query kernel and the
     fused ingest+query kernel -> values [BN, Q].
 
@@ -411,8 +417,8 @@ def _select_quantiles(spec, bins_pos, bins_neg, zero_count, count, qs):
     idx_pos = jnp.clip(counts[:, q_total:], first_pos, last_pos)
 
     # Decode all Q indices at once through the mapping's own array path
-    # (bit-identical bucket representatives to the XLA engine).
-    key_lo = jnp.int32(spec.key_offset)
+    # (bit-identical bucket representatives to the XLA engine); key_lo is
+    # the per-stream [BN, 1] i32 window edge, broadcast over the Q axis.
     val_neg = -spec.mapping.value_array(idx_neg + key_lo)  # [BN, Q]
     val_pos = spec.mapping.value_array(idx_pos + key_lo)
 
@@ -432,6 +438,7 @@ def _quantile_kernel(
     bins_neg_ref,
     zero_count_ref,
     count_ref,
+    key_offset_ref,
     qs_ref,
     out_ref,
     *,
@@ -444,6 +451,7 @@ def _quantile_kernel(
         bins_neg_ref[:],
         zero_count_ref[:],
         count_ref[:],
+        key_offset_ref[:],
         qs_ref[:],
     )
 
@@ -479,6 +487,7 @@ def fused_quantile(
             bins_spec,
             col_spec,
             col_spec,
+            col_spec,
             pl.BlockSpec((1, q_total), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
@@ -491,6 +500,7 @@ def fused_quantile(
         state.bins_neg,
         state.zero_count[:, None],
         state.count[:, None],
+        state.key_offset[:, None].astype(jnp.int32),
         qs[None, :],
     )
 
@@ -517,7 +527,8 @@ def add(
 
     (hist_pos, hist_neg, zero, count, total, vmin, vmax, clow, chigh) = (
         ingest_histogram(
-            spec, v, w, weighted=weights is not None, interpret=interpret
+            spec, v, w, state.key_offset,
+            weighted=weights is not None, interpret=interpret,
         )
     )
     return SketchState(
@@ -530,4 +541,5 @@ def add(
         max=jnp.maximum(state.max, vmax[:, 0]),
         collapsed_low=state.collapsed_low + clow[:, 0],
         collapsed_high=state.collapsed_high + chigh[:, 0],
+        key_offset=state.key_offset,
     )

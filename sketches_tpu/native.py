@@ -274,6 +274,7 @@ class NativeDDSketch:
             max=jnp.asarray([c[4]], jnp.float32),
             collapsed_low=jnp.asarray([c[5]], jnp.float32),
             collapsed_high=jnp.asarray([c[6]], jnp.float32),
+            key_offset=jnp.asarray([self.key_offset], jnp.int32),
         )
 
     @classmethod
@@ -281,13 +282,15 @@ class NativeDDSketch:
         """Extract one stream of a batched state into a native sketch."""
         import jax
 
+        host = jax.device_get(state)
+        # The stream's window may have drifted from the spec default via
+        # recentering -- the native sketch adopts the per-stream offset.
         sk = cls(
             spec.relative_accuracy,
             spec.n_bins,
-            spec.key_offset,
+            int(host.key_offset[stream]),
             mapping=spec.mapping_name,
         )
-        host = jax.device_get(state)
         counters = np.asarray(
             [
                 host.zero_count[stream], host.count[stream], host.sum[stream],

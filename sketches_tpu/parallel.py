@@ -132,6 +132,11 @@ def psum_merge(state: SketchState, axis_name: str) -> SketchState:
         max=lax.pmax(state.max, axis_name),
         collapsed_low=lax.psum(state.collapsed_low, axis_name),
         collapsed_high=lax.psum(state.collapsed_high, axis_name),
+        # Window offsets are identical on every shard (the distributed tier
+        # broadcasts one init and never recenters partials independently):
+        # pmax is the identity fold that also lets shard_map's replication
+        # checker prove the output is replicated over the value axis.
+        key_offset=lax.pmax(state.key_offset, axis_name),
     )
 
 
@@ -141,7 +146,7 @@ def _state_pspec(value_axis: Optional[str], stream_axis: Optional[str]) -> Sketc
     p1 = P(value_axis, stream_axis)
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
-        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1,
+        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
     )
 
 
@@ -150,7 +155,7 @@ def _merged_pspec(stream_axis: Optional[str]) -> SketchState:
     p1 = P(stream_axis)
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
-        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1,
+        min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
     )
 
 
