@@ -68,6 +68,7 @@ __all__ = [
     "recenter",
     "recenter_to_data",
     "auto_offset",
+    "overflow_risk",
     "BatchedDDSketch",
 ]
 
@@ -93,13 +94,23 @@ class SketchSpec:
     # window on key(1.0) = 0, covering values in roughly
     # [gamma**key_offset, gamma**(key_offset + n_bins)).
     key_offset: Optional[int] = None
-    # Accumulator dtype for bins and counters.  f32 mass accumulation is
-    # exact only up to 2**24 (~16.7M) per bin/counter: beyond that, unit
-    # adds round away (x + 1 == x) and quantiles bias silently.  For larger
-    # per-stream counts use jnp.float64 (requires jax_enable_x64; emulated
-    # and slow on TPU) or shard the stream and merge.  The exact-regime
-    # bound is tested in tests/test_batched.py.
+    # Working dtype for values and the sum/min/max bookkeeping.  f32 mass
+    # accumulation is exact only up to 2**24 (~16.7M) per bin/counter:
+    # beyond that, unit adds round away (x + 1 == x) and quantiles bias
+    # silently.  For exactness past that ceiling set ``bin_dtype=jnp.int32``
+    # below; jnp.float64 also works but is emulated and slow on TPU.
     dtype: jnp.dtype = jnp.float32
+    # Dtype of the bins and mass counters (zero_count/count/collapsed_*).
+    # None follows ``dtype``.  ``jnp.int32`` gives *exact* accumulation to
+    # 2**31 - 1 (~2.1e9) per bin -- the escape hatch for unit/integer-weight
+    # workloads whose hot bins cross f32's 2**24 exact ceiling (VERDICT r2
+    # item 3).  Integer mode requires integer-valued weights (fractional
+    # weights truncate); sum/min/max stay in ``dtype``.  The Pallas engine
+    # still ingests *unit-weight* calls (its per-call f32 histogram deltas
+    # are exact integers bounded by the batch width, then accumulate into
+    # the integer state); weighted calls and all queries take the XLA
+    # path, whose integer scatter/cumsum/rank-select never rounds.
+    bin_dtype: Optional[jnp.dtype] = None
 
     def __post_init__(self):
         if not 0.0 < self.relative_accuracy < 1.0:
@@ -108,10 +119,17 @@ class SketchSpec:
             raise ValueError("n_bins must be >= 2")
         if self.key_offset is None:
             object.__setattr__(self, "key_offset", -(self.n_bins // 2))
+        if self.bin_dtype is None:
+            object.__setattr__(self, "bin_dtype", self.dtype)
         # Windows wider than the f32-representable value range are fine:
         # bins beyond what f32 ingest can reach stay empty, and
         # ``KeyMapping.value_array`` saturates its decode to the positive
         # finite f32 range, so quantiles remain finite for any window.
+
+    @property
+    def bins_integer(self) -> bool:
+        """Whether the bins/counters accumulate in an integer dtype."""
+        return jnp.issubdtype(jnp.dtype(self.bin_dtype), jnp.integer)
 
     @functools.cached_property
     def mapping(self) -> KeyMapping:
@@ -139,6 +157,7 @@ class SketchSpec:
                 self.n_bins,
                 self.key_offset,
                 jnp.dtype(self.dtype).name,
+                jnp.dtype(self.bin_dtype).name,
             )
         )
 
@@ -183,14 +202,15 @@ class SketchState:
 def init(spec: SketchSpec, n_streams: int) -> SketchState:
     """Allocate an empty batch of ``n_streams`` sketches (all shapes static)."""
     dt = spec.dtype
-    zeros2 = jnp.zeros((n_streams, spec.n_bins), dtype=dt)
-    zeros1 = jnp.zeros((n_streams,), dtype=dt)
+    bd = spec.bin_dtype
+    zeros2 = jnp.zeros((n_streams, spec.n_bins), dtype=bd)
+    zeros1 = jnp.zeros((n_streams,), dtype=bd)
     return SketchState(
         bins_pos=zeros2,
         bins_neg=jnp.zeros_like(zeros2),
         zero_count=zeros1,
         count=jnp.zeros_like(zeros1),
-        sum=jnp.zeros_like(zeros1),
+        sum=jnp.zeros((n_streams,), dtype=dt),
         min=jnp.full((n_streams,), jnp.inf, dtype=dt),
         max=jnp.full((n_streams,), -jnp.inf, dtype=dt),
         collapsed_low=jnp.zeros_like(zeros1),
@@ -267,17 +287,26 @@ def add(
     w_zero = jnp.where(jnp.logical_and(is_zero, live), w, 0)
     w_live = w_pos + w_neg + w_zero
 
+    # Mass accumulates in the bin dtype: a no-op cast in the default f32
+    # mode; in integer mode (exact past f32's 2**24 ceiling) the cast-then-
+    # sum order keeps every partial integral (fractional weights truncate
+    # -- integer mode's documented contract).
+    bd = jnp.dtype(spec.bin_dtype)
+    wb_pos = w_pos.astype(bd)
+    wb_neg = w_neg.astype(bd)
+    wb_zero = w_zero.astype(bd)
     scatter = jax.vmap(_row_scatter_add)
-    signed = w_pos + w_neg  # mass that hits a store (pos or neg)
+    signed = wb_pos + wb_neg  # mass that hits a store (pos or neg)
     inf = jnp.asarray(jnp.inf, spec.dtype)
     # NaN values must not poison min/max (host tier: NaN comparisons are
     # false, so _min/_max stay untouched) -- mask them out of the extrema.
     finite_live = jnp.logical_and(live, jnp.logical_not(jnp.isnan(v)))
+    zero_b = jnp.asarray(0, bd)
     return SketchState(
-        bins_pos=scatter(state.bins_pos, idx, w_pos),
-        bins_neg=scatter(state.bins_neg, idx, w_neg),
-        zero_count=state.zero_count + w_zero.sum(-1),
-        count=state.count + w_live.sum(-1),
+        bins_pos=scatter(state.bins_pos, idx, wb_pos),
+        bins_neg=scatter(state.bins_neg, idx, wb_neg),
+        zero_count=state.zero_count + wb_zero.sum(-1),
+        count=state.count + (wb_pos + wb_neg + wb_zero).sum(-1),
         # Mask dead lanes out of v (not just the weight): NaN/inf padding with
         # weight 0 would otherwise poison the product (NaN * 0 = NaN).  Live
         # NaNs still poison sum, which is host-tier parity.
@@ -285,9 +314,9 @@ def add(
         min=jnp.minimum(state.min, jnp.where(finite_live, v, inf).min(-1)),
         max=jnp.maximum(state.max, jnp.where(finite_live, v, -inf).max(-1)),
         collapsed_low=state.collapsed_low
-        + jnp.where(clamped_low, signed, 0).sum(-1),
+        + jnp.where(clamped_low, signed, zero_b).sum(-1),
         collapsed_high=state.collapsed_high
-        + jnp.where(clamped_high, signed, 0).sum(-1),
+        + jnp.where(clamped_high, signed, zero_b).sum(-1),
         key_offset=state.key_offset,
     )
 
@@ -333,28 +362,53 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     # backends that fail to fuse the 3-D compare+reduce (large-N CPU runs)
     # would materialize gigabytes (ADVICE r2).  Q is small (typically <= 8),
     # so the unrolled reduces cost the same as the broadcast form.
+    #
+    # Integer-bin mode compares in *integer space*: casting a cum past 2**24
+    # to f32 would round the very masses the mode exists to keep exact, so
+    # the float thresholds become integer ones via the integer-cum
+    # identities  cum < x  <=>  cum <= ceil(x) - 1  and
+    # cum <= r  <=>  cum <= floor(r).
+    #
     # Negative branch (reference: key_at_rank(neg_count - 1 - rank,
     # lower=False), i.e. smallest key with cum >= r + 1 = #(cum < r + 1)).
-    rev_rank = neg_count[:, None] - 1 - rank
+    rev_rank = neg_count.astype(spec.dtype)[:, None] - 1 - rank
     q_total = rank.shape[1]
+    int_mode = spec.bins_integer
+    # Guard the float->int threshold casts against the dtype edge (count at
+    # the very ceiling): f32 values at/above 2**31 would overflow the cast.
+    _int_safe = float(2**31 - 256)
+    if int_mode:
+        thr_neg = jnp.clip(
+            jnp.ceil(rev_rank + 1) - 1, -_int_safe, _int_safe
+        ).astype(cum_neg.dtype)
+        masks_neg = [
+            cum_neg <= thr_neg[:, qi : qi + 1] for qi in range(q_total)
+        ]
+    else:
+        masks_neg = [
+            cum_neg < rev_rank[:, qi : qi + 1] + 1 for qi in range(q_total)
+        ]
     idx_neg = jnp.stack(
-        [
-            (cum_neg < rev_rank[:, qi : qi + 1] + 1).sum(-1).astype(jnp.int32)
-            for qi in range(q_total)
-        ],
-        axis=1,
+        [m.sum(-1).astype(jnp.int32) for m in masks_neg], axis=1
     )
     idx_neg = jnp.clip(idx_neg, _first_occupied(state.bins_neg)[:, None],
                        _last_occupied(state.bins_neg)[:, None])
 
     # Positive branch (lower=True -> smallest key with cum > r = #(cum <= r)).
-    pos_rank = rank - (state.zero_count + neg_count)[:, None]
+    pos_rank = rank - (state.zero_count + neg_count).astype(spec.dtype)[:, None]
+    if int_mode:
+        thr_pos = jnp.clip(
+            jnp.floor(pos_rank), -_int_safe, _int_safe
+        ).astype(cum_pos.dtype)
+        masks_pos = [
+            cum_pos <= thr_pos[:, qi : qi + 1] for qi in range(q_total)
+        ]
+    else:
+        masks_pos = [
+            cum_pos <= pos_rank[:, qi : qi + 1] for qi in range(q_total)
+        ]
     idx_pos = jnp.stack(
-        [
-            (cum_pos <= pos_rank[:, qi : qi + 1]).sum(-1).astype(jnp.int32)
-            for qi in range(q_total)
-        ],
-        axis=1,
+        [m.sum(-1).astype(jnp.int32) for m in masks_pos], axis=1
     )
     idx_pos = jnp.clip(idx_pos, _first_occupied(state.bins_pos)[:, None],
                        _last_occupied(state.bins_pos)[:, None])
@@ -363,8 +417,8 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     val_neg = -spec.mapping.value_array(idx_neg + key_lo, dtype=spec.dtype)
     val_pos = spec.mapping.value_array(idx_pos + key_lo, dtype=spec.dtype)
 
-    in_neg = rank < neg_count[:, None]
-    in_zero = rank < (neg_count + state.zero_count)[:, None]
+    in_neg = rank < neg_count.astype(spec.dtype)[:, None]
+    in_zero = rank < (neg_count + state.zero_count).astype(spec.dtype)[:, None]
     out = jnp.where(in_neg, val_neg, jnp.where(in_zero, 0.0, val_pos))
 
     valid = jnp.logical_and(
@@ -429,6 +483,30 @@ def merge_axis(spec: SketchSpec, state: SketchState, axis: int = 0) -> SketchSta
             state.key_offset, 0, axis, keepdims=False
         ),
     )
+
+
+def overflow_risk(spec: SketchSpec, state: SketchState):
+    """Per-stream hottest-bin mass and its fraction of the exact ceiling.
+
+    Returns ``(max_bin_mass[N], fraction[N])`` where the ceiling is the bin
+    dtype's exact-accumulation bound: 2**24 for f32 (unit adds round away
+    past it), ``iinfo.max`` for integer bins.  The overflow analog of the
+    collapse counters (VERDICT r2 item 3): poll it between batches and
+    switch to ``bin_dtype=jnp.int32`` when the f32 fraction approaches 1.
+    Integer-bin headroom is a *hard* bound on the whole stream including
+    any later merges -- int32 addition wraps silently, so a fold of shards
+    must keep every merged bin/counter under ``iinfo.max`` (budget
+    per-shard headroom by the planned fan-in; f32 bins merely lose unit
+    precision past their ceiling, int32 bins corrupt).
+    """
+    m = jnp.maximum(state.bins_pos.max(-1), state.bins_neg.max(-1))
+    m = jnp.maximum(m, state.zero_count).astype(spec.dtype)
+    if spec.bins_integer:
+        ceiling = float(jnp.iinfo(spec.bin_dtype).max)
+    else:
+        # Exact integer accumulation holds through 2**(mantissa bits + 1).
+        ceiling = float(2 ** (jnp.finfo(spec.bin_dtype).nmant + 1))
+    return m, m / jnp.asarray(ceiling, spec.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -592,6 +670,7 @@ class BatchedDDSketch:
         state: Optional[SketchState] = None,
         engine: str = "auto",
         auto_recenter: Optional[bool] = None,
+        bin_dtype=None,
     ):
         # Auto-recenter policy: center each stream's window on its first
         # batch (median key) unless the caller pinned the window explicitly
@@ -605,6 +684,7 @@ class BatchedDDSketch:
                 mapping_name=mapping,
                 n_bins=n_bins,
                 key_offset=key_offset,
+                bin_dtype=bin_dtype,
             )
         self.spec = spec
         self.state = init(spec, n_streams) if state is None else state
@@ -623,14 +703,19 @@ class BatchedDDSketch:
                 functools.partial(kernels.add, spec, interpret=interpret),
                 donate_argnums=(0,),
             )
-            self._quantile = jax.jit(
-                functools.partial(kernels.fused_quantile, spec, interpret=interpret)
-            )
             self._batch_ok = lambda s: kernels.supports(spec, n_streams, s)
         else:
             self._add_pallas = None
-            self._quantile = jax.jit(functools.partial(quantile, spec))
             self._batch_ok = lambda s: False
+        if use_pallas and not spec.bins_integer:
+            self._quantile = jax.jit(
+                functools.partial(kernels.fused_quantile, spec, interpret=interpret)
+            )
+        else:
+            # Integer-bin specs always query via the XLA path: its integer
+            # cumsum + rank compare is exact past 2**24 where the kernel's
+            # bf16-term scan is not (see kernels.fused_quantile).
+            self._quantile = jax.jit(functools.partial(quantile, spec))
         self._merge = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
@@ -704,7 +789,14 @@ class BatchedDDSketch:
                 self._policy_binned = np.asarray(
                     self.state.count - self.state.zero_count, np.float64
                 )
-        elif self._add_pallas is not None and self._batch_ok(values.shape[-1]):
+        elif (
+            self._add_pallas is not None
+            and self._batch_ok(values.shape[-1])
+            # Weighted integer-mode calls need the XLA path: the kernel's
+            # f32 deltas are only guaranteed exact for unit weights (see
+            # kernels.add).
+            and not (self.spec.bins_integer and weights is not None)
+        ):
             self.state = self._add_pallas(self.state, values, weights)
         else:
             self.state = self._add_xla(self.state, values, weights)
@@ -762,16 +854,25 @@ class BatchedDDSketch:
         self.state = self._recenter_to_data(self.state)
         return self
 
+    def overflow_risk(self):
+        """(max_bin_mass[N], fraction-of-exact-ceiling[N]) -- see
+        :func:`overflow_risk`.  Poll between batches like the collapse
+        counters; a fraction near 1 calls for ``bin_dtype=jnp.int32``."""
+        return overflow_risk(self.spec, self.state)
+
     def collapsed_fraction(self) -> jax.Array:
         """Per-stream fraction of binned mass that hit a window edge -> [N].
 
         The observability signal for the recenter policy; reading it forces
         a host sync, so poll it between batches, not per add.
         """
-        binned = self.state.count - self.state.zero_count
-        return (self.state.collapsed_low + self.state.collapsed_high) / (
-            jnp.maximum(binned, 1)
+        binned = (self.state.count - self.state.zero_count).astype(
+            self.spec.dtype
         )
+        collapsed = (
+            self.state.collapsed_low + self.state.collapsed_high
+        ).astype(self.spec.dtype)
+        return collapsed / jnp.maximum(binned, 1)
 
     def maybe_recenter(self, threshold: float = 0.01) -> bool:
         """Arm a recenter for streams whose *recent* collapse exceeds ``threshold``.
@@ -923,15 +1024,18 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
     mirroring ingest-side collapse.
     """
     n = len(sketches)
-    bins_pos = np.zeros((n, spec.n_bins), dtype=np.float32)
-    bins_neg = np.zeros((n, spec.n_bins), dtype=np.float32)
-    zero = np.zeros((n,), dtype=np.float32)
-    count = np.zeros((n,), dtype=np.float32)
-    total = np.zeros((n,), dtype=np.float32)
-    vmin = np.full((n,), np.inf, dtype=np.float32)
-    vmax = np.full((n,), -np.inf, dtype=np.float32)
-    clow = np.zeros((n,), dtype=np.float32)
-    chigh = np.zeros((n,), dtype=np.float32)
+    # f64 staging: the host tier's masses are exact Python floats, and an
+    # f32 intermediate would round counts past 2**24 *before* the final
+    # cast -- defeating integer-bin specs on this interop path.
+    bins_pos = np.zeros((n, spec.n_bins), dtype=np.float64)
+    bins_neg = np.zeros((n, spec.n_bins), dtype=np.float64)
+    zero = np.zeros((n,), dtype=np.float64)
+    count = np.zeros((n,), dtype=np.float64)
+    total = np.zeros((n,), dtype=np.float64)
+    vmin = np.full((n,), np.inf, dtype=np.float64)
+    vmax = np.full((n,), -np.inf, dtype=np.float64)
+    clow = np.zeros((n,), dtype=np.float64)
+    chigh = np.zeros((n,), dtype=np.float64)
     for i, sk in enumerate(sketches):
         # Same gamma is not enough: all three mappings share gamma at equal
         # alpha but scale the key multiplier differently, so keys are only
@@ -963,15 +1067,24 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
         # Round-trip the device-only collapse counters when present.
         clow[i] += getattr(sk, "_collapsed_low", 0.0)
         chigh[i] += getattr(sk, "_collapsed_high", 0.0)
+    bd = np.dtype(jnp.dtype(spec.bin_dtype).name)
+    if np.issubdtype(bd, np.integer):
+        # Host (f64) masses round to the nearest integer for integer-bin
+        # specs; fractional host weights are outside integer mode's contract.
+        cast = lambda a: jnp.asarray(np.rint(a).astype(bd))
+    else:
+        cast = lambda a: jnp.asarray(a.astype(bd))
+    dt = np.dtype(jnp.dtype(spec.dtype).name)
+    f32 = lambda a: jnp.asarray(a.astype(dt))
     return SketchState(
-        bins_pos=jnp.asarray(bins_pos),
-        bins_neg=jnp.asarray(bins_neg),
-        zero_count=jnp.asarray(zero),
-        count=jnp.asarray(count),
-        sum=jnp.asarray(total),
-        min=jnp.asarray(vmin),
-        max=jnp.asarray(vmax),
-        collapsed_low=jnp.asarray(clow),
-        collapsed_high=jnp.asarray(chigh),
+        bins_pos=cast(bins_pos),
+        bins_neg=cast(bins_neg),
+        zero_count=cast(zero),
+        count=cast(count),
+        sum=f32(total),
+        min=f32(vmin),
+        max=f32(vmax),
+        collapsed_low=cast(clow),
+        collapsed_high=cast(chigh),
         key_offset=jnp.full((n,), spec.key_offset, dtype=jnp.int32),
     )

@@ -38,6 +38,7 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
             "n_bins": spec.n_bins,
             "key_offset": spec.key_offset,
             "dtype": jnp.dtype(spec.dtype).name,
+            "bin_dtype": jnp.dtype(spec.bin_dtype).name,
         }
     )
     # Write through a file object: np.savez on a bare path silently appends
@@ -59,6 +60,8 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             n_bins=meta["n_bins"],
             key_offset=meta["key_offset"],
             dtype=jnp.dtype(meta["dtype"]),
+            # Pre-r3 checkpoints carry no bin_dtype: bins followed dtype.
+            bin_dtype=jnp.dtype(meta.get("bin_dtype", meta["dtype"])),
         )
         arrays = {
             name: jnp.asarray(data[name]) for name in _FIELDS if name in data

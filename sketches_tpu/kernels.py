@@ -470,6 +470,14 @@ def fused_quantile(
     vmapped binary search through HBM.
     """
     n = state.n_streams
+    if spec.bins_integer:
+        # The VMEM scan's bf16-term splits are exact only for f32-ceiling
+        # masses; integer-bin (exact > 2**24) queries take the XLA path,
+        # whose integer cumsum + integer rank compare never rounds.
+        raise NotImplementedError(
+            "fused_quantile requires float bins; integer-bin specs query"
+            " via batched.quantile (the facades route this automatically)"
+        )
     qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
     q_total = qs.shape[0]
     if q_total == 0:  # empty quantile list: nothing to launch
@@ -520,6 +528,25 @@ def add(
     docstring), so arbitrary f32 weights accumulate without quantization.
     """
     v = values.astype(spec.dtype)
+    if spec.bins_integer:
+        # Integer-bin exactness holds only when this call's f32 deltas are
+        # themselves exact integers below 2**24.  Unit-weight calls satisfy
+        # that by construction (per-bin/per-counter mass <= the static batch
+        # width); weighted calls can concentrate arbitrary mass into one
+        # bin in one call, which would round in f32 *before* the integer
+        # cast -- route those through batched.add, whose weights cast to
+        # the integer dtype before the scatter (the facades do this
+        # automatically).
+        if weights is not None:
+            raise NotImplementedError(
+                "Pallas add with integer bins supports unit-weight calls"
+                " only; weighted integer-mode ingest uses batched.add"
+            )
+        if values.shape[-1] >= 1 << 24:
+            raise NotImplementedError(
+                "Pallas add with integer bins needs per-call batch width"
+                " < 2**24 to keep f32 deltas exact"
+            )
     if weights is None:
         w = jnp.ones_like(v)
     else:
@@ -531,15 +558,20 @@ def add(
             weighted=weights is not None, interpret=interpret,
         )
     )
+    # The kernel emits f32 per-call deltas; accumulation into the state
+    # happens here in the state's own bin dtype.  For integer-bin specs the
+    # guards above make every delta an exact integer below 2**24, so the
+    # cast is lossless and the int32 state stays exact past f32's ceiling.
+    bd = state.bins_pos.dtype
     return SketchState(
-        bins_pos=state.bins_pos + hist_pos,
-        bins_neg=state.bins_neg + hist_neg,
-        zero_count=state.zero_count + zero[:, 0],
-        count=state.count + count[:, 0],
+        bins_pos=state.bins_pos + hist_pos.astype(bd),
+        bins_neg=state.bins_neg + hist_neg.astype(bd),
+        zero_count=state.zero_count + zero[:, 0].astype(bd),
+        count=state.count + count[:, 0].astype(bd),
         sum=state.sum + total[:, 0],
         min=jnp.minimum(state.min, vmin[:, 0]),
         max=jnp.maximum(state.max, vmax[:, 0]),
-        collapsed_low=state.collapsed_low + clow[:, 0],
-        collapsed_high=state.collapsed_high + chigh[:, 0],
+        collapsed_low=state.collapsed_low + clow[:, 0].astype(bd),
+        collapsed_high=state.collapsed_high + chigh[:, 0].astype(bd),
         key_offset=state.key_offset,
     )

@@ -241,9 +241,12 @@ class DistributedDDSketch:
         def local_add(st, values, weights):
             # Static per-trace choice: the Pallas engine when this call's
             # shard-local batch width qualifies, the portable XLA scatter
-            # path otherwise.
-            if use_pallas and kernels.supports(
-                spec, n_local_streams, values.shape[-1]
+            # path otherwise.  Weighted integer-bin calls always take XLA
+            # (kernel f32 deltas are only unit-weight-exact; kernels.add).
+            if (
+                use_pallas
+                and kernels.supports(spec, n_local_streams, values.shape[-1])
+                and not (spec.bins_integer and weights is not None)
             ):
                 return kernels.add(spec, st, values, weights, interpret=interpret)
             return add(spec, st, values, weights)
@@ -288,9 +291,11 @@ class DistributedDDSketch:
                 fold, mesh=mesh, in_specs=(state_spec,), out_specs=merged_spec
             )
         )
-        if use_pallas:
+        if use_pallas and not spec.bins_integer:
             # Per-shard fused query: each device runs the Pallas kernel on
             # its own stream slice of the folded state (qs replicated).
+            # (Integer-bin specs take the XLA query below -- exact past
+            # 2**24 where the kernel's bf16-term scan is not.)
             def local_quantile(st, qs):
                 return kernels.fused_quantile(spec, st, qs, interpret=interpret)
 
