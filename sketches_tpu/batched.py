@@ -189,6 +189,21 @@ class SketchState:
     # static window cannot give (VERDICT r2 item 2).  ``spec.key_offset``
     # remains the construction-time default.
     key_offset: jax.Array  # [n_streams]
+    # Occupied-window bounds (int32, window-relative, combined over both
+    # stores): the smallest/largest bin index that may hold mass --
+    # ``(n_bins, -1)`` for an empty stream.  Maintained during ingest (the
+    # min/max over each batch's bin indices is nearly free) so a query can
+    # restrict its HBM traffic to the globally occupied span instead of
+    # streaming every bin (VERDICT r2 item 1c).  Conservative by contract:
+    # always a superset of true occupancy (a merge or edge fold may leave
+    # the span wider than the surviving mass).
+    occ_lo: jax.Array  # [n_streams]
+    occ_hi: jax.Array  # [n_streams]
+    # Total mass in the negative store (bin dtype) == ``bins_neg.sum(-1)``.
+    # Carried as a counter so rank thresholds (which need the negative
+    # total *before* any bin is read) are available to single-pass windowed
+    # query kernels without a pre-scan of ``bins_neg``.
+    neg_total: jax.Array  # [n_streams]
 
     @property
     def n_streams(self) -> int:
@@ -216,7 +231,25 @@ def init(spec: SketchSpec, n_streams: int) -> SketchState:
         collapsed_low=jnp.zeros_like(zeros1),
         collapsed_high=jnp.zeros_like(zeros1),
         key_offset=jnp.full((n_streams,), spec.key_offset, dtype=jnp.int32),
+        occ_lo=jnp.full((n_streams,), spec.n_bins, dtype=jnp.int32),
+        occ_hi=jnp.full((n_streams,), -1, dtype=jnp.int32),
+        neg_total=jnp.zeros_like(zeros1),
     )
+
+
+def _occupied_bounds(bins_pos: jax.Array, bins_neg: jax.Array):
+    """Exact combined-store occupied span -> (lo [N], hi [N]) int32.
+
+    ``(n_bins, -1)`` for empty rows -- the state's empty-span sentinels.
+    Used where the bins are being streamed anyway (recenter, host interop);
+    ingest maintains the running bounds incrementally instead.
+    """
+    n_bins = bins_pos.shape[-1]
+    occ = jnp.logical_or(bins_pos > 0, bins_neg > 0)
+    iota = jnp.arange(n_bins, dtype=jnp.int32)
+    lo = jnp.min(jnp.where(occ, iota, n_bins), axis=-1).astype(jnp.int32)
+    hi = jnp.max(jnp.where(occ, iota, -1), axis=-1).astype(jnp.int32)
+    return lo, hi
 
 
 def _keys_and_masks(spec: SketchSpec, key_offset: jax.Array, values: jax.Array):
@@ -302,6 +335,7 @@ def add(
     # false, so _min/_max stay untouched) -- mask them out of the extrema.
     finite_live = jnp.logical_and(live, jnp.logical_not(jnp.isnan(v)))
     zero_b = jnp.asarray(0, bd)
+    hits = jnp.logical_and(live, jnp.logical_or(is_pos, is_neg))
     return SketchState(
         bins_pos=scatter(state.bins_pos, idx, wb_pos),
         bins_neg=scatter(state.bins_neg, idx, wb_neg),
@@ -318,6 +352,23 @@ def add(
         collapsed_high=state.collapsed_high
         + jnp.where(clamped_high, signed, zero_b).sum(-1),
         key_offset=state.key_offset,
+        # Running occupied bounds: min/max of this batch's store-hitting bin
+        # indices (w > 0 lanes landing in either store).  Conservative under
+        # integer-mode weight truncation (a lane whose mass truncates to 0
+        # still widens the span) -- superset is the contract.
+        occ_lo=jnp.minimum(
+            state.occ_lo,
+            jnp.min(
+                jnp.where(hits, idx, jnp.int32(spec.n_bins)), axis=-1
+            ).astype(jnp.int32),
+        ),
+        occ_hi=jnp.maximum(
+            state.occ_hi,
+            jnp.max(jnp.where(hits, idx, jnp.int32(-1)), axis=-1).astype(
+                jnp.int32
+            ),
+        ),
+        neg_total=state.neg_total + wb_neg.sum(-1),
     )
 
 
@@ -457,6 +508,9 @@ def merge(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchState:
         collapsed_low=a.collapsed_low + b.collapsed_low,
         collapsed_high=a.collapsed_high + b.collapsed_high,
         key_offset=a.key_offset,
+        occ_lo=jnp.minimum(a.occ_lo, b.occ_lo),
+        occ_hi=jnp.maximum(a.occ_hi, b.occ_hi),
+        neg_total=a.neg_total + b.neg_total,
     )
 
 
@@ -482,6 +536,9 @@ def merge_axis(spec: SketchSpec, state: SketchState, axis: int = 0) -> SketchSta
         key_offset=jax.lax.index_in_dim(
             state.key_offset, 0, axis, keepdims=False
         ),
+        occ_lo=state.occ_lo.min(axis),
+        occ_hi=state.occ_hi.max(axis),
+        neg_total=state.neg_total.sum(axis),
     )
 
 
@@ -500,7 +557,13 @@ def overflow_risk(spec: SketchSpec, state: SketchState):
     precision past their ceiling, int32 bins corrupt).
     """
     m = jnp.maximum(state.bins_pos.max(-1), state.bins_neg.max(-1))
-    m = jnp.maximum(m, state.zero_count).astype(spec.dtype)
+    m = jnp.maximum(m, state.zero_count)
+    # count (total mass) is itself a bin-dtype accumulator and is >= any
+    # single bin, so it always saturates/wraps first -- monitoring only the
+    # hottest bin would understate risk by up to n_bins x.
+    m = jnp.maximum(m, jnp.maximum(state.count, state.neg_total)).astype(
+        spec.dtype
+    )
     if spec.bins_integer:
         ceiling = float(jnp.iinfo(spec.bin_dtype).max)
     else:
@@ -550,9 +613,15 @@ def recenter(
 
     roll = jax.vmap(_roll_row)
     signed = state.bins_pos + state.bins_neg
+    new_pos = roll(state.bins_pos, idx)
+    new_neg = roll(state.bins_neg, idx)
+    # Recenter streams every bin anyway, so the occupied bounds re-derive
+    # exactly from the rolled bins (tighter than shifting the old bounds,
+    # which would keep conservative slack across repeated recenters).
+    occ_lo, occ_hi = _occupied_bounds(new_pos, new_neg)
     return SketchState(
-        bins_pos=roll(state.bins_pos, idx),
-        bins_neg=roll(state.bins_neg, idx),
+        bins_pos=new_pos,
+        bins_neg=new_neg,
         zero_count=state.zero_count,
         count=state.count,
         sum=state.sum,
@@ -562,6 +631,9 @@ def recenter(
         collapsed_high=state.collapsed_high
         + jnp.where(above, signed, 0).sum(-1),
         key_offset=new_off,
+        occ_lo=occ_lo,
+        occ_hi=occ_hi,
+        neg_total=state.neg_total,
     )
 
 
@@ -1076,6 +1148,10 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
         cast = lambda a: jnp.asarray(a.astype(bd))
     dt = np.dtype(jnp.dtype(spec.dtype).name)
     f32 = lambda a: jnp.asarray(a.astype(dt))
+    occ = np.logical_or(bins_pos > 0, bins_neg > 0)
+    iota = np.arange(spec.n_bins, dtype=np.int32)
+    occ_lo = np.where(occ, iota, spec.n_bins).min(axis=-1).astype(np.int32)
+    occ_hi = np.where(occ, iota, -1).max(axis=-1).astype(np.int32)
     return SketchState(
         bins_pos=cast(bins_pos),
         bins_neg=cast(bins_neg),
@@ -1087,4 +1163,7 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
         collapsed_low=cast(clow),
         collapsed_high=cast(chigh),
         key_offset=jnp.full((n,), spec.key_offset, dtype=jnp.int32),
+        occ_lo=jnp.asarray(occ_lo),
+        occ_hi=jnp.asarray(occ_hi),
+        neg_total=cast(bins_neg.sum(axis=-1)),
     )

@@ -72,6 +72,22 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             arrays["key_offset"] = jnp.full(
                 arrays["count"].shape, spec.key_offset, dtype=jnp.int32
             )
+        # Pre-occupied-bounds checkpoints: derive bounds and the negative
+        # total from the bins (host-side, one pass; exact).
+        if "occ_lo" not in arrays:
+            bp = np.asarray(data["bins_pos"])
+            bn = np.asarray(data["bins_neg"])
+            occ = np.logical_or(bp > 0, bn > 0)
+            iota = np.arange(spec.n_bins, dtype=np.int32)
+            arrays["occ_lo"] = jnp.asarray(
+                np.where(occ, iota, spec.n_bins).min(axis=-1).astype(np.int32)
+            )
+            arrays["occ_hi"] = jnp.asarray(
+                np.where(occ, iota, -1).max(axis=-1).astype(np.int32)
+            )
+            arrays["neg_total"] = jnp.asarray(
+                bn.sum(axis=-1).astype(bn.dtype)
+            )
         state = SketchState(**arrays)
     return spec, state
 

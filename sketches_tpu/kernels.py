@@ -115,6 +115,9 @@ def _ingest_kernel(
     max_ref,
     clow_ref,
     chigh_ref,
+    olo_ref,
+    ohi_ref,
+    negc_ref,
     *,
     spec: SketchSpec,
     weighted: bool,
@@ -178,6 +181,9 @@ def _ingest_kernel(
         max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
         clow_ref[:] = jnp.zeros_like(clow_ref)
         chigh_ref[:] = jnp.zeros_like(chigh_ref)
+        olo_ref[:] = jnp.full_like(olo_ref, n_bins)
+        ohi_ref[:] = jnp.full_like(ohi_ref, -1)
+        negc_ref[:] = jnp.zeros_like(negc_ref)
 
     # A[n, h, s] = (hi[n, s] == h) * w[n, s] in bf16.  Unit weights (w = 1)
     # are exact in one bf16 term.  Arbitrary f32 weights are split into
@@ -227,6 +233,18 @@ def _ingest_kernel(
     chigh_ref[:] += jnp.sum(
         jnp.where(clamped_high, signed, 0.0), axis=1, keepdims=True
     )
+    # Occupied-bounds deltas (VERDICT r3 query-byte-cut seam): min/max of
+    # this chunk's store-hitting indices, same contract as batched.add.
+    hits = jnp.logical_and(live, jnp.logical_or(is_pos, is_neg))
+    olo_ref[:] = jnp.minimum(
+        olo_ref[:],
+        jnp.min(jnp.where(hits, idx, n_bins), axis=1, keepdims=True),
+    )
+    ohi_ref[:] = jnp.maximum(
+        ohi_ref[:],
+        jnp.max(jnp.where(hits, idx, -1), axis=1, keepdims=True),
+    )
+    negc_ref[:] += jnp.sum(w_neg, axis=1, keepdims=True)
 
 
 def ingest_histogram(
@@ -242,9 +260,11 @@ def ingest_histogram(
 
     ``values``/``weights``: [n_streams, batch] f32; ``key_offset``:
     [n_streams] i32 per-stream window edges (``state.key_offset``).  Returns
-    ``(hist_pos, hist_neg, zero, count, sum, min, max, clow, chigh)`` --
-    the two [n_streams, n_bins] histograms of this batch plus the per-stream
-    [n_streams, 1] counter deltas, all from a single HBM read of the values.
+    ``(hist_pos, hist_neg, zero, count, sum, min, max, clow, chigh,
+    occ_lo, occ_hi, neg_total)`` -- the two [n_streams, n_bins] histograms
+    of this batch plus the per-stream [n_streams, 1] counter deltas
+    (occupied bounds as i32 columns), all from a single HBM read of the
+    values.
     """
     n, s = values.shape
     # The kernel builds its one-hots in _BS-wide sub-chunks, so peak VMEM
@@ -253,6 +273,7 @@ def ingest_histogram(
     grid = (n // _BN, s // bs)
     hist_shape = jax.ShapeDtypeStruct((n, spec.n_bins), jnp.float32)
     col_shape = jax.ShapeDtypeStruct((n, 1), jnp.float32)
+    icol_shape = jax.ShapeDtypeStruct((n, 1), jnp.int32)
     hist_spec = pl.BlockSpec(
         (_BN, spec.n_bins), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
@@ -265,8 +286,9 @@ def ingest_histogram(
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
             col_spec,
         ],
-        out_specs=[hist_spec, hist_spec] + [col_spec] * 7,
-        out_shape=[hist_shape, hist_shape] + [col_shape] * 7,
+        out_specs=[hist_spec, hist_spec] + [col_spec] * 10,
+        out_shape=[hist_shape, hist_shape] + [col_shape] * 7
+        + [icol_shape, icol_shape, col_shape],
         interpret=interpret,
     )(values, weights, key_offset[:, None].astype(jnp.int32))
 
@@ -552,11 +574,12 @@ def add(
     else:
         w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
 
-    (hist_pos, hist_neg, zero, count, total, vmin, vmax, clow, chigh) = (
-        ingest_histogram(
-            spec, v, w, state.key_offset,
-            weighted=weights is not None, interpret=interpret,
-        )
+    (
+        hist_pos, hist_neg, zero, count, total, vmin, vmax, clow, chigh,
+        olo, ohi, negc,
+    ) = ingest_histogram(
+        spec, v, w, state.key_offset,
+        weighted=weights is not None, interpret=interpret,
     )
     # The kernel emits f32 per-call deltas; accumulation into the state
     # happens here in the state's own bin dtype.  For integer-bin specs the
@@ -574,4 +597,7 @@ def add(
         collapsed_low=state.collapsed_low + clow[:, 0].astype(bd),
         collapsed_high=state.collapsed_high + chigh[:, 0].astype(bd),
         key_offset=state.key_offset,
+        occ_lo=jnp.minimum(state.occ_lo, olo[:, 0]),
+        occ_hi=jnp.maximum(state.occ_hi, ohi[:, 0]),
+        neg_total=state.neg_total + negc[:, 0].astype(bd),
     )

@@ -264,6 +264,10 @@ class NativeDDSketch:
         pos, neg = self.bins()
         c = self._counters()
         as_row = lambda x: jnp.asarray(x, jnp.float32)[None]
+        occ = np.logical_or(pos > 0, neg > 0)
+        iota = np.arange(self.n_bins, dtype=np.int32)
+        occ_lo = int(np.where(occ, iota, self.n_bins).min())
+        occ_hi = int(np.where(occ, iota, -1).max())
         return SketchState(
             bins_pos=as_row(pos),
             bins_neg=as_row(neg),
@@ -275,6 +279,9 @@ class NativeDDSketch:
             collapsed_low=jnp.asarray([c[5]], jnp.float32),
             collapsed_high=jnp.asarray([c[6]], jnp.float32),
             key_offset=jnp.asarray([self.key_offset], jnp.int32),
+            occ_lo=jnp.asarray([occ_lo], jnp.int32),
+            occ_hi=jnp.asarray([occ_hi], jnp.int32),
+            neg_total=jnp.asarray([neg.sum()], jnp.float32),
         )
 
     @classmethod

@@ -137,6 +137,9 @@ def psum_merge(state: SketchState, axis_name: str) -> SketchState:
         # pmax is the identity fold that also lets shard_map's replication
         # checker prove the output is replicated over the value axis.
         key_offset=lax.pmax(state.key_offset, axis_name),
+        occ_lo=lax.pmin(state.occ_lo, axis_name),
+        occ_hi=lax.pmax(state.occ_hi, axis_name),
+        neg_total=lax.psum(state.neg_total, axis_name),
     )
 
 
@@ -147,6 +150,7 @@ def _state_pspec(value_axis: Optional[str], stream_axis: Optional[str]) -> Sketc
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
         min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
+        occ_lo=p1, occ_hi=p1, neg_total=p1,
     )
 
 
@@ -156,6 +160,7 @@ def _merged_pspec(stream_axis: Optional[str]) -> SketchState:
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
         min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
+        occ_lo=p1, occ_hi=p1, neg_total=p1,
     )
 
 

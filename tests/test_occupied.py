@@ -1,0 +1,173 @@
+"""Occupied-bounds + negative-total state counters (VERDICT r3 item 1c).
+
+The contract under test: for every state the framework can produce,
+
+* ``occ_lo/occ_hi`` bound all nonzero bins of BOTH stores (a conservative
+  superset -- ingest, merge, recenter, collectives, interop, checkpoint);
+* ``neg_total`` equals ``bins_neg.sum(-1)`` exactly (unit weights) or to
+  f32 rounding (arbitrary weights);
+* empty streams carry the ``(n_bins, -1)`` sentinels.
+
+These counters are what lets a query read only the occupied window instead
+of every bin -- an invariant violation silently truncates quantile mass, so
+the tests assert the superset property, not equality.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sketches_tpu import kernels
+from sketches_tpu.batched import (
+    BatchedDDSketch,
+    SketchSpec,
+    _occupied_bounds,
+    add,
+    from_host_sketches,
+    init,
+    merge,
+    merge_axis,
+    quantile,
+    recenter,
+    to_host_sketches,
+)
+
+
+def assert_invariants(spec, state, *, weighted=False):
+    bp = np.asarray(state.bins_pos)
+    bn = np.asarray(state.bins_neg)
+    occ = np.logical_or(bp > 0, bn > 0)
+    iota = np.arange(spec.n_bins)
+    true_lo = np.where(occ, iota, spec.n_bins).min(axis=-1)
+    true_hi = np.where(occ, iota, -1).max(axis=-1)
+    olo = np.asarray(state.occ_lo)
+    ohi = np.asarray(state.occ_hi)
+    # Conservative superset: bounds may be wider, never narrower.
+    assert (olo <= true_lo).all(), (olo, true_lo)
+    assert (ohi >= true_hi).all(), (ohi, true_hi)
+    # Sentinels stay in-range.
+    assert (olo >= 0).all() and (olo <= spec.n_bins).all()
+    assert (ohi >= -1).all() and (ohi <= spec.n_bins - 1).all()
+    neg = np.asarray(state.neg_total, np.float64)
+    ref = bn.sum(axis=-1, dtype=np.float64)
+    if weighted:
+        np.testing.assert_allclose(neg, ref, rtol=1e-5, atol=1e-4)
+    else:
+        np.testing.assert_array_equal(neg, ref)
+
+
+def _values(n, s, seed=0):
+    r = np.random.RandomState(seed)
+    v = r.lognormal(0, 2, (n, s)).astype(np.float32)
+    v[:, ::5] *= -1.0
+    v[:, ::9] = 0.0
+    return v
+
+
+def test_init_sentinels():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    st = init(spec, 4)
+    assert (np.asarray(st.occ_lo) == 128).all()
+    assert (np.asarray(st.occ_hi) == -1).all()
+    assert (np.asarray(st.neg_total) == 0).all()
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+def test_add_maintains_bounds(weighted):
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256)
+    st = init(spec, 8)
+    v = _values(8, 64)
+    w = (
+        np.random.RandomState(3).uniform(0.5, 2.0, v.shape).astype(np.float32)
+        if weighted
+        else None
+    )
+    st = add(spec, st, jnp.asarray(v), None if w is None else jnp.asarray(w))
+    st = add(spec, st, jnp.asarray(_values(8, 64, seed=1)))
+    assert_invariants(spec, st, weighted=weighted)
+    # A stream that only ever saw zeros stays on the empty sentinels.
+    st2 = add(spec, init(spec, 2), jnp.zeros((2, 16)))
+    assert (np.asarray(st2.occ_lo) == 256).all()
+    assert (np.asarray(st2.occ_hi) == -1).all()
+
+
+def test_pallas_parity_bounds():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    v = jnp.asarray(_values(128, 128))
+    ref = add(spec, init(spec, 128), v)
+    got = kernels.add(spec, init(spec, 128), v, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got.occ_lo), np.asarray(ref.occ_lo))
+    np.testing.assert_array_equal(np.asarray(got.occ_hi), np.asarray(ref.occ_hi))
+    np.testing.assert_allclose(
+        np.asarray(got.neg_total), np.asarray(ref.neg_total), rtol=1e-6
+    )
+
+
+def test_merge_and_axis_fold():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256)
+    a = add(spec, init(spec, 4), jnp.asarray(_values(4, 32)))
+    b = add(spec, init(spec, 4), jnp.asarray(_values(4, 32, seed=7) * 100))
+    m = merge(spec, a, b)
+    assert_invariants(spec, m)
+    stacked = jax.tree.map(lambda x, y: jnp.stack([x, y]), a, b)
+    assert_invariants(spec, merge_axis(spec, stacked, 0))
+
+
+def test_recenter_rederives_bounds():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    st = add(spec, init(spec, 4), jnp.asarray(_values(4, 32)))
+    shifted = recenter(spec, st, st.key_offset + 37)
+    assert_invariants(spec, shifted)
+    # Mass folded into the edge must keep bin 0 inside the bounds.
+    far = recenter(spec, st, st.key_offset + 10_000)
+    assert_invariants(spec, far)
+
+
+def test_host_interop_roundtrip_bounds():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    st = add(spec, init(spec, 3), jnp.asarray(_values(3, 40)))
+    back = from_host_sketches(spec, to_host_sketches(spec, st))
+    assert_invariants(spec, back)
+
+
+def test_checkpoint_backcompat_derives_bounds(tmp_path):
+    """A pre-r3 checkpoint (no occ/neg arrays) restores with exact bounds."""
+    from sketches_tpu import checkpoint
+
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    b = BatchedDDSketch(4, spec=spec, engine="xla")
+    b.add(_values(4, 32))
+    path = tmp_path / "ck.npz"
+    checkpoint.save(str(path), b)
+    # Strip the new arrays to simulate an old checkpoint.
+    with np.load(path) as data:
+        kept = {
+            k: data[k]
+            for k in data.files
+            if k not in ("occ_lo", "occ_hi", "neg_total")
+        }
+    with open(path, "wb") as f:
+        np.savez_compressed(f, **kept)
+    spec2, st2 = checkpoint.restore_state(str(path))
+    assert_invariants(spec2, st2)
+    # Derivation from bins is exact, not just conservative.
+    olo, ohi = _occupied_bounds(st2.bins_pos, st2.bins_neg)
+    np.testing.assert_array_equal(np.asarray(st2.occ_lo), np.asarray(olo))
+    np.testing.assert_array_equal(np.asarray(st2.occ_hi), np.asarray(ohi))
+
+
+def test_distributed_psum_folds_bounds():
+    from jax.sharding import Mesh
+
+    from sketches_tpu.parallel import DistributedDDSketch
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    dist = DistributedDDSketch(
+        8, value_axis="values",
+        mesh=Mesh(np.asarray(jax.devices()[:2]), ("values",)),
+        spec=SketchSpec(relative_accuracy=0.01, n_bins=256),
+    )
+    dist.add(_values(8, 64))
+    assert_invariants(dist.spec, dist.merged_state())
