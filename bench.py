@@ -152,6 +152,32 @@ def bench_native(n: int = 2_000_000):
 # ---------------------------------------------------------------------------
 
 
+def _windowed_query_fn(spec, state, use_pallas):
+    """(query_fn, plan_dict) on the production path the facades take:
+    the windowed Pallas kernel with the plan derived from this state's
+    bound counters, or the XLA query where the kernels don't apply."""
+    import functools as _ft
+
+    from sketches_tpu import kernels
+    from sketches_tpu.batched import quantile
+
+    if not (use_pallas and not spec.bins_integer):
+        return _ft.partial(quantile, spec), None
+    lo_w, n_w, w_t, with_neg = kernels.plan_state_window(spec, state)
+    plan = {
+        "lo_wblock": lo_w, "n_wblocks": n_w, "w_tiles": w_t,
+        "with_neg": with_neg,
+    }
+
+    def q_fn(st_, qs_):
+        return kernels.fused_quantile_windowed(
+            spec, st_, qs_, lo_w,
+            n_wblocks=n_w, w_tiles=w_t, with_neg=with_neg,
+        )
+
+    return q_fn, plan
+
+
 def _device_bench(
     spec,
     n_streams: int,
@@ -170,11 +196,6 @@ def _device_bench(
     on_tpu = jax.default_backend() == "tpu"
     use_pallas = on_tpu and kernels.supports(spec, n_streams, batch)
     add_fn = functools.partial(kernels.add, spec) if use_pallas else functools.partial(add, spec)
-    q_fn = (
-        functools.partial(kernels.fused_quantile, spec)
-        if use_pallas
-        else functools.partial(quantile, spec)
-    )
 
     step = jax.jit(add_fn, donate_argnums=(0,))
 
@@ -215,13 +236,17 @@ def _device_bench(
         / (time.perf_counter() - t0)
     )
 
-    # Device-sustained multi-quantile latency (north-star metric #2):
-    # queries chained in one jit (qs perturbed per iteration so the loop
-    # body is not hoisted as invariant -- the perturbation must survive f32
-    # rounding, hence the relative scale), with the measured per-dispatch
-    # tunnel floor subtracted.  Repeated dispatches give the p50/p99 spread
-    # of the *sustained* rate; a host-attached deployment adds only its own
+    # Device-sustained multi-quantile latency (north-star metric #2),
+    # measured on the production query path: the windowed kernel with the
+    # plan the facade would derive from this state's bound counters
+    # (occupied span + store participation).  Queries chain in one jit (qs
+    # perturbed per iteration so the loop body is not hoisted as
+    # invariant -- the perturbation must survive f32 rounding, hence the
+    # relative scale), with the measured per-dispatch tunnel floor
+    # subtracted.  Repeated dispatches give the p50/p99 spread of the
+    # *sustained* rate; a host-attached deployment adds only its own
     # (microsecond) dispatch cost on top.
+    q_fn, plan = _windowed_query_fn(spec, state, use_pallas)
     qs = jnp.asarray(QS4, dtype=jnp.float32)
     q_iters = max(16, 2 * fused_k)
 
@@ -246,6 +271,7 @@ def _device_bench(
         "ingest_fused_per_s": round(fused_per_s, 1),
         "query_p50_s": round(float(np.percentile(lat, 50)), 6),
         "query_p99_s": round(float(np.percentile(lat, 99)), 6),
+        "query_window": plan,
         "collapsed_mass_frac": round(collapsed / max(total, 1.0), 6),
     }
 
@@ -339,24 +365,36 @@ def bench_shard_query(profile: bool):
     on_tpu = jax.default_backend() == "tpu"
     use_pallas = on_tpu and kernels.supports(spec, n, batch)
     add_fn = functools.partial(kernels.add if use_pallas else add, spec)
-    q_fn = functools.partial(
-        kernels.fused_quantile if use_pallas else quantile, spec
-    )
 
-    values = jax.jit(
-        lambda k: jnp.exp(1.5 * jax.random.normal(k, (n, batch), jnp.float32))
-    )(jax.random.PRNGKey(0))
-    state = jax.jit(add_fn, donate_argnums=0)(init(spec, n), values)
-    _sync(state.count[:1])
-    qs = jnp.asarray(QS4, jnp.float32)
-
-    with _maybe_trace(profile, "c2s_shard_query"):
+    def one_case(sigma):
+        values = jax.jit(
+            lambda k: jnp.exp(
+                jnp.float32(sigma) * jax.random.normal(k, (n, batch), jnp.float32)
+            )
+        )(jax.random.PRNGKey(0))
+        state = jax.jit(add_fn, donate_argnums=0)(init(spec, n), values)
+        _sync(state.count[:1])
+        qs = jnp.asarray(QS4, jnp.float32)
+        q_fn, plan = _windowed_query_fn(spec, state, use_pallas)
         query_s = fused_per_iter_s(
             lambda i, acc: acc
             + q_fn(state, qs * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
             jnp.float32(0.0),
             iters=64,
         )
+        return state, {
+            "query_sustained_s": round(query_s, 6),
+            "window": plan,
+        }
+
+    with _maybe_trace(profile, "c2s_shard_query"):
+        # Worst case: a window-filling distribution (sigma=1.5 spans the
+        # whole 512-bin window) -- every bin byte must stream.
+        state, wide = one_case(1.5)
+        # Realistic telemetry: concentrated positive values (span <= 2 of
+        # 4 window tiles) -- the windowed plan reads only the occupied
+        # slice of one store.
+        _, conc = one_case(0.3)
 
         # Per-shard merge compute: fold a second state in, iterated.  The
         # accumulating carry is the merge output, so every iteration reads
@@ -372,7 +410,8 @@ def bench_shard_query(profile: bool):
         "engine": "pallas" if use_pallas else "xla",
         "n_streams": n,
         "state_gb": round(2 * n * 512 * 4 / 1e9, 3),
-        "query_sustained_s": round(query_s, 6),
+        "wide_window": wide,
+        "concentrated": conc,
         "merge_per_shard_s": round(merge_s, 6),
     }
 
@@ -565,7 +604,7 @@ def verify_on_device():
             for f in (
                 "bins_pos", "bins_neg", "zero_count", "count", "sum",
                 "min", "max", "collapsed_low", "collapsed_high",
-                "occ_lo", "occ_hi", "neg_total",
+                "pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total",
             ):
                 a, b = np.asarray(getattr(got, f)), np.asarray(getattr(ref, f))
                 if not np.allclose(a, b, rtol=1e-5, atol=1e-4, equal_nan=True):

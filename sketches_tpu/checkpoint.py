@@ -72,19 +72,19 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             arrays["key_offset"] = jnp.full(
                 arrays["count"].shape, spec.key_offset, dtype=jnp.int32
             )
-        # Pre-occupied-bounds checkpoints: derive bounds and the negative
-        # total from the bins (host-side, one pass; exact).
-        if "occ_lo" not in arrays:
-            bp = np.asarray(data["bins_pos"])
+        # Pre-occupied-bounds checkpoints: derive per-store bounds and the
+        # negative total from the bins (host-side, one pass; exact).
+        if "pos_lo" not in arrays:
+            from sketches_tpu.batched import occupied_bounds_np
+
+            for name, bins in (
+                ("pos", np.asarray(data["bins_pos"])),
+                ("neg", np.asarray(data["bins_neg"])),
+            ):
+                lo, hi = occupied_bounds_np(bins)
+                arrays[f"{name}_lo"] = jnp.asarray(lo)
+                arrays[f"{name}_hi"] = jnp.asarray(hi)
             bn = np.asarray(data["bins_neg"])
-            occ = np.logical_or(bp > 0, bn > 0)
-            iota = np.arange(spec.n_bins, dtype=np.int32)
-            arrays["occ_lo"] = jnp.asarray(
-                np.where(occ, iota, spec.n_bins).min(axis=-1).astype(np.int32)
-            )
-            arrays["occ_hi"] = jnp.asarray(
-                np.where(occ, iota, -1).max(axis=-1).astype(np.int32)
-            )
             arrays["neg_total"] = jnp.asarray(
                 bn.sum(axis=-1).astype(bn.dtype)
             )

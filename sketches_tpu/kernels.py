@@ -49,7 +49,14 @@ from jax.experimental.pallas import tpu as pltpu
 from sketches_tpu.batched import SketchSpec, SketchState
 from sketches_tpu.mapping import zero_threshold
 
-__all__ = ["supports", "select_engine", "ingest_histogram", "fused_quantile", "add"]
+__all__ = [
+    "supports",
+    "select_engine",
+    "ingest_histogram",
+    "fused_quantile",
+    "fused_quantile_windowed",
+    "add",
+]
 
 LO = 128  # lane width: low radix of the key split
 _BN = 128  # streams per block
@@ -102,31 +109,36 @@ def select_engine(spec: SketchSpec, n_streams: int, engine: str):
     return use_pallas, jax.default_backend() != "tpu"
 
 
+# Packed scalar-column layout of the ingest kernel's third output: one
+# [n_streams, 16] f32 block instead of twelve [n_streams, 1] outputs --
+# TPU HBM layout pads the minor dimension to the 128-lane tile, so every
+# skinny column would cost a full 128-lane stripe (0.5 GB each at 1M
+# streams; twelve of them broke the 1M compile outright).  Bounds ride as
+# f32 (exact integers far below 2**24).
+_COL = {
+    "zero": 0, "count": 1, "sum": 2, "min": 3, "max": 4,
+    "clow": 5, "chigh": 6, "pos_lo": 7, "pos_hi": 8,
+    "neg_lo": 9, "neg_hi": 10, "neg_total": 11,
+}
+_NCOLS = 16  # lane-friendly width (12 used + 4 pad)
+
+
 def _ingest_kernel(
     values_ref,
     weights_ref,
     key_offset_ref,
     hist_pos_ref,
     hist_neg_ref,
-    zero_ref,
-    count_ref,
-    sum_ref,
-    min_ref,
-    max_ref,
-    clow_ref,
-    chigh_ref,
-    olo_ref,
-    ohi_ref,
-    negc_ref,
+    cols_ref,
     *,
     spec: SketchSpec,
     weighted: bool,
 ):
     """One (stream-block, value-chunk) grid cell of the fused ingest.
 
-    Emits the scalar bookkeeping (zero/count/sum/min/max/collapse) as
-    per-stream column outputs alongside the histograms, so the values make
-    exactly one trip from HBM.
+    Emits the scalar bookkeeping (zero/count/sum/min/max/collapse/bounds)
+    as one packed [block, 16] column output (layout ``_COL``) alongside the
+    histograms, so the values make exactly one trip from HBM.
     """
     j = pl.program_id(1)
     n_bins = spec.n_bins
@@ -170,20 +182,37 @@ def _ingest_kernel(
     bn, bs = v.shape
     dims = (((2,), (1,)), ((0,), (0,)))  # contract s; batch n
 
+    bn_rows = values_ref.shape[0]
+
     @pl.when(j == 0)
     def _():
         hist_pos_ref[:] = jnp.zeros_like(hist_pos_ref)
         hist_neg_ref[:] = jnp.zeros_like(hist_neg_ref)
-        zero_ref[:] = jnp.zeros_like(zero_ref)
-        count_ref[:] = jnp.zeros_like(count_ref)
-        sum_ref[:] = jnp.zeros_like(sum_ref)
-        min_ref[:] = jnp.full_like(min_ref, jnp.inf)
-        max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
-        clow_ref[:] = jnp.zeros_like(clow_ref)
-        chigh_ref[:] = jnp.zeros_like(chigh_ref)
-        olo_ref[:] = jnp.full_like(olo_ref, n_bins)
-        ohi_ref[:] = jnp.full_like(ohi_ref, -1)
-        negc_ref[:] = jnp.zeros_like(negc_ref)
+        # Identity row built from lane selects (a jnp constant array would
+        # be a captured const, which pallas rejects).
+        lane0 = jax.lax.broadcasted_iota(jnp.int32, (bn_rows, _NCOLS), 1)
+        ident = jnp.where(
+            lane0 == _COL["min"],
+            jnp.inf,
+            jnp.where(
+                lane0 == _COL["max"],
+                -jnp.inf,
+                jnp.where(
+                    jnp.logical_or(
+                        lane0 == _COL["pos_lo"], lane0 == _COL["neg_lo"]
+                    ),
+                    jnp.float32(n_bins),
+                    jnp.where(
+                        jnp.logical_or(
+                            lane0 == _COL["pos_hi"], lane0 == _COL["neg_hi"]
+                        ),
+                        jnp.float32(-1.0),
+                        jnp.float32(0.0),
+                    ),
+                ),
+            ),
+        )
+        cols_ref[:] = ident.astype(jnp.float32)
 
     # A[n, h, s] = (hi[n, s] == h) * w[n, s] in bf16.  Unit weights (w = 1)
     # are exact in one bf16 term.  Arbitrary f32 weights are split into
@@ -216,35 +245,66 @@ def _ingest_kernel(
     hist_pos_ref[:] += c[:, :n_bins]
     hist_neg_ref[:] += c[:, n_bins:]
 
-    zero_ref[:] += jnp.sum(w_zero, axis=1, keepdims=True)
-    count_ref[:] += jnp.sum(w_live, axis=1, keepdims=True)
-    sum_ref[:] += jnp.sum(jnp.where(live, v, 0.0) * w_live, axis=1, keepdims=True)
-    min_ref[:] = jnp.minimum(
-        min_ref[:],
-        jnp.min(jnp.where(finite_live, v, jnp.inf), axis=1, keepdims=True),
+    # Per-store occupied-bounds deltas (VERDICT r3 query-byte-cut seam) in
+    # f32: min/max of this chunk's bin indices per store, same contract as
+    # batched.add.
+    hits_pos = jnp.logical_and(live, is_pos)
+    hits_neg = jnp.logical_and(live, is_neg)
+    idx_f = idx.astype(jnp.float32)
+    nb_f, neg1 = jnp.float32(n_bins), jnp.float32(-1.0)
+    # One packed [bn, 16] delta block, folded into the output columns with
+    # a single min/max/add pass per identity class.
+    delta = [None] * _NCOLS
+    delta[_COL["zero"]] = jnp.sum(w_zero, axis=1, keepdims=True)
+    delta[_COL["count"]] = jnp.sum(w_live, axis=1, keepdims=True)
+    delta[_COL["sum"]] = jnp.sum(
+        jnp.where(live, v, 0.0) * w_live, axis=1, keepdims=True
     )
-    max_ref[:] = jnp.maximum(
-        max_ref[:],
-        jnp.max(jnp.where(finite_live, v, -jnp.inf), axis=1, keepdims=True),
+    delta[_COL["min"]] = jnp.min(
+        jnp.where(finite_live, v, jnp.inf), axis=1, keepdims=True
     )
-    clow_ref[:] += jnp.sum(
+    delta[_COL["max"]] = jnp.max(
+        jnp.where(finite_live, v, -jnp.inf), axis=1, keepdims=True
+    )
+    delta[_COL["clow"]] = jnp.sum(
         jnp.where(clamped_low, signed, 0.0), axis=1, keepdims=True
     )
-    chigh_ref[:] += jnp.sum(
+    delta[_COL["chigh"]] = jnp.sum(
         jnp.where(clamped_high, signed, 0.0), axis=1, keepdims=True
     )
-    # Occupied-bounds deltas (VERDICT r3 query-byte-cut seam): min/max of
-    # this chunk's store-hitting indices, same contract as batched.add.
-    hits = jnp.logical_and(live, jnp.logical_or(is_pos, is_neg))
-    olo_ref[:] = jnp.minimum(
-        olo_ref[:],
-        jnp.min(jnp.where(hits, idx, n_bins), axis=1, keepdims=True),
+    delta[_COL["pos_lo"]] = jnp.min(
+        jnp.where(hits_pos, idx_f, nb_f), axis=1, keepdims=True
     )
-    ohi_ref[:] = jnp.maximum(
-        ohi_ref[:],
-        jnp.max(jnp.where(hits, idx, -1), axis=1, keepdims=True),
+    delta[_COL["pos_hi"]] = jnp.max(
+        jnp.where(hits_pos, idx_f, neg1), axis=1, keepdims=True
     )
-    negc_ref[:] += jnp.sum(w_neg, axis=1, keepdims=True)
+    delta[_COL["neg_lo"]] = jnp.min(
+        jnp.where(hits_neg, idx_f, nb_f), axis=1, keepdims=True
+    )
+    delta[_COL["neg_hi"]] = jnp.max(
+        jnp.where(hits_neg, idx_f, neg1), axis=1, keepdims=True
+    )
+    delta[_COL["neg_total"]] = jnp.sum(w_neg, axis=1, keepdims=True)
+    zeros_col = jnp.zeros((bn_rows, 1), jnp.float32)
+    for c in range(_NCOLS):
+        if delta[c] is None:
+            delta[c] = zeros_col
+    dblock = jnp.concatenate(delta, axis=1)  # [bn, 16]
+    prev = cols_ref[:]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (bn_rows, _NCOLS), 1)
+    is_min = jnp.logical_or(
+        lane == _COL["min"],
+        jnp.logical_or(lane == _COL["pos_lo"], lane == _COL["neg_lo"]),
+    )
+    is_max = jnp.logical_or(
+        lane == _COL["max"],
+        jnp.logical_or(lane == _COL["pos_hi"], lane == _COL["neg_hi"]),
+    )
+    cols_ref[:] = jnp.where(
+        is_min,
+        jnp.minimum(prev, dblock),
+        jnp.where(is_max, jnp.maximum(prev, dblock), prev + dblock),
+    )
 
 
 def ingest_histogram(
@@ -260,11 +320,11 @@ def ingest_histogram(
 
     ``values``/``weights``: [n_streams, batch] f32; ``key_offset``:
     [n_streams] i32 per-stream window edges (``state.key_offset``).  Returns
-    ``(hist_pos, hist_neg, zero, count, sum, min, max, clow, chigh,
-    occ_lo, occ_hi, neg_total)`` -- the two [n_streams, n_bins] histograms
-    of this batch plus the per-stream [n_streams, 1] counter deltas
-    (occupied bounds as i32 columns), all from a single HBM read of the
-    values.
+    ``(hist_pos, hist_neg, cols)`` -- the two [n_streams, n_bins]
+    histograms of this batch plus the packed [n_streams, 16] per-stream
+    counter deltas (column layout ``_COL``: zero/count/sum/min/max/
+    collapse/per-store occupied bounds/negative total), all from a single
+    HBM read of the values.
     """
     n, s = values.shape
     # The kernel builds its one-hots in _BS-wide sub-chunks, so peak VMEM
@@ -272,23 +332,26 @@ def ingest_histogram(
     bs = _wide_block(s, spec.n_bins, _BS)
     grid = (n // _BN, s // bs)
     hist_shape = jax.ShapeDtypeStruct((n, spec.n_bins), jnp.float32)
-    col_shape = jax.ShapeDtypeStruct((n, 1), jnp.float32)
-    icol_shape = jax.ShapeDtypeStruct((n, 1), jnp.int32)
     hist_spec = pl.BlockSpec(
         (_BN, spec.n_bins), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
-    col_spec = pl.BlockSpec((_BN, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    cols_spec = pl.BlockSpec(
+        (_BN, _NCOLS), lambda i, j: (i, 0), memory_space=pltpu.VMEM
+    )
     return pl.pallas_call(
         functools.partial(_ingest_kernel, spec=spec, weighted=weighted),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
-            col_spec,
+            pl.BlockSpec((_BN, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=[hist_spec, hist_spec] + [col_spec] * 10,
-        out_shape=[hist_shape, hist_shape] + [col_shape] * 7
-        + [icol_shape, icol_shape, col_shape],
+        out_specs=[hist_spec, hist_spec, cols_spec],
+        out_shape=[
+            hist_shape,
+            hist_shape,
+            jax.ShapeDtypeStruct((n, _NCOLS), jnp.float32),
+        ],
         interpret=interpret,
     )(values, weights, key_offset[:, None].astype(jnp.int32))
 
@@ -535,6 +598,314 @@ def fused_quantile(
     )
 
 
+# ---------------------------------------------------------------------------
+# Windowed multi-quantile query (VERDICT r3 item 1: read only the occupied
+# span, skip the negative store when it is empty)
+# ---------------------------------------------------------------------------
+
+
+def _cumsum_tile(x: jax.Array, n_terms: int = 3) -> jax.Array:
+    """Inclusive prefix sum of one 128-lane tile ``[rows, 128]`` on the MXU.
+
+    Same exact 3-term bf16 split as :func:`_cumsum_bins`, but single-tile:
+    the cross-tile offsets are the caller's carry (the windowed kernel
+    accumulates them across its column grid instead of a second matmul).
+    """
+    tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (LO, LO), 0)
+        <= jax.lax.broadcasted_iota(jnp.int32, (LO, LO), 1)
+    ).astype(jnp.bfloat16)
+    out = jnp.zeros(x.shape, jnp.float32)
+    for p in _exact_bf16_terms(x, n_terms):
+        out = out + jax.lax.dot_general(
+            p, tri, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return out
+
+
+def _windowed_kernel(
+    lo_ref,  # scalar prefetch: [1] i32, window start in w_tiles-wide blocks
+    *refs,
+    spec: SketchSpec,
+    w_tiles: int,
+    with_neg: bool,
+    q_total: int,
+    bn: int,
+):
+    """One (stream-block, column-tile) cell of the windowed query.
+
+    The grid walks the occupied window's 128-bin column tiles sequentially
+    (j fastest); VMEM scratch carries the running prefix totals, the
+    per-threshold rank counts, and the exact per-store occupied bounds
+    across tiles, and the final tile decodes.  Bins outside the window are
+    provably empty (the state's ``occ_lo/occ_hi`` invariant), so their
+    cumulative mass is either 0 (below) or the store total (above) -- the
+    decode accounts for the ``below`` prefix by offsetting counts with the
+    window start and clipping into the exact occupied bounds.
+
+    All per-stream rank thresholds arrive pre-packed in ONE column block
+    (``thr_ref``: pos_rank[Q] | rev_rank+1[Q] | key_offset) -- computed
+    once in XLA by the caller.  Column blocks are ``w_tiles`` 128-lane
+    tiles wide (wider DMAs stream ~3x faster than single-tile blocks,
+    measured), walked as an in-cell loop; rank counts are mask-matvecs on
+    the MXU (measured 4x cheaper than VPU lane-axis reductions).
+    """
+    if with_neg:
+        (bp_ref, bn_ref, thr_ref, out_ref, carry, counts) = refs
+    else:
+        (bp_ref, thr_ref, out_ref, carry, counts) = refs
+    j = pl.program_id(1)
+    n_wblocks = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        carry[:] = jnp.zeros_like(carry)
+        counts[:] = jnp.zeros_like(counts)
+
+    thr = thr_ref[:]  # [bn, 2Q + 5]
+    pos_rank = thr[:, :q_total]
+    rev_p1 = thr[:, q_total : 2 * q_total]
+    ones8 = jnp.ones((LO, 8), jnp.bfloat16)
+
+    def one_store(block, carry_col, thresholds, strict):
+        acc = jnp.zeros((bn, q_total), jnp.float32)
+        for t in range(w_tiles):
+            bins = jax.lax.slice_in_dim(block, t * LO, (t + 1) * LO, axis=1)
+            local = _cumsum_tile(bins)
+            cum = local + carry[:, carry_col : carry_col + 1]
+            cols = []
+            for qi in range(q_total):
+                th = thresholds[:, qi : qi + 1]
+                m = (cum < th) if strict else (cum <= th)
+                cols.append(
+                    jax.lax.dot_general(
+                        m.astype(jnp.bfloat16), ones8,
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )[:, :1]
+                )
+            acc = acc + jnp.concatenate(cols, axis=1)
+            carry[:, carry_col : carry_col + 1] += local[:, LO - 1 :]
+        return acc  # [bn, Q]
+
+    # Positive store: smallest key with cum > r  ==  #(cum <= pos_rank).
+    counts[:, :q_total] += one_store(bp_ref[:], 0, pos_rank, False)
+    if with_neg:
+        # Negative store (lower=False): #(cum < rev_rank + 1).
+        counts[:, q_total:] += one_store(bn_ref[:], 1, rev_p1, True)
+
+    @pl.when(j == n_wblocks - 1)
+    def _():
+        window_lo = lo_ref[0] * jnp.int32(w_tiles * LO)
+        # Exact per-store occupied bounds ride in the packed block (state
+        # counters -- no per-tile bounds work in the kernel); degenerate
+        # ranks clip into them.  Empty stores carry the (n_bins, -1)
+        # sentinels: the clip then yields index n_bins -- one past the
+        # window -- whose decode stays finite only because value_array
+        # saturates out-of-range keys; the branch select discards it.  (A
+        # decode via table gather would need an explicit in-range clamp
+        # here first.)
+        bds = thr[:, 2 * q_total + 1 :].astype(jnp.int32)  # [bn, 4]
+        first_pos = bds[:, 0:1]
+        last_pos = jnp.maximum(bds[:, 1:2], first_pos)
+        cts = counts[:].astype(jnp.int32)
+        # Bins below the window hold zero mass: each counts toward any
+        # threshold >= 0, hence the window_lo offset; the exact-bounds clip
+        # then absorbs every degenerate case (negative thresholds,
+        # rank-past-total rounding, empty stores).
+        idx_pos = jnp.clip(window_lo + cts[:, :q_total], first_pos, last_pos)
+        key_lo = thr[:, 2 * q_total : 2 * q_total + 1].astype(jnp.int32)
+        val_pos = spec.mapping.value_array(idx_pos + key_lo)
+        # Branch predicates from the packed thresholds alone:
+        #   rank < neg_count        <=>  rev_p1 > 0
+        #   rank < neg_count + zero <=>  pos_rank < 0
+        if with_neg:
+            first_neg = bds[:, 2:3]
+            last_neg = jnp.maximum(bds[:, 3:4], first_neg)
+            idx_neg = jnp.clip(
+                window_lo + cts[:, q_total:], first_neg, last_neg
+            )
+            val_neg = -spec.mapping.value_array(idx_neg + key_lo)
+            val = jnp.where(
+                rev_p1 > 0.0,
+                val_neg,
+                jnp.where(pos_rank < 0.0, 0.0, val_pos),
+            )
+        else:
+            val = jnp.where(pos_rank < 0.0, 0.0, val_pos)
+        out_ref[:] = val
+
+
+def plan_window(spec: SketchSpec, occ_lo_min: int, occ_hi_max: int):
+    """Host-side window plan from globally folded occupied bounds.
+
+    Returns ``(lo_wblock, n_wblocks, w_tiles)`` for
+    :func:`fused_quantile_windowed`: the widest column-block width in
+    {4, 2, 1} tiles that the span warrants (wider blocks stream ~3x faster;
+    a 1-tile span should not pay a 4-tile window), aligned so the dynamic
+    block index is exact.  An empty batch (``occ_hi_max < 0``) plans the
+    minimal window at position 0.
+    """
+    tiles_total = spec.n_bins // LO
+    if occ_hi_max < 0:
+        lo_t = hi_t = 0
+    else:
+        lo_t = max(0, min(occ_lo_min, occ_hi_max)) // LO
+        hi_t = min(occ_hi_max // LO, tiles_total - 1)
+    # Pick the width that reads the fewest tiles (alignment can force a
+    # wide-block window to cover up to w-1 extra tiles on each side --
+    # measured 2.4x query cost on a 2-tile span whose wide window read 4);
+    # ties go to the wider block (wider DMAs stream faster).
+    best = None
+    for w in (4, 2, 1):
+        if tiles_total % w:
+            continue
+        lo_w = lo_t // w
+        n_w = hi_t // w - lo_w + 1
+        if best is None or n_w * w < best[1] * best[2]:
+            best = (lo_w, n_w, w)
+    return best
+
+
+_PLAN_STATS = None
+
+
+def plan_state_window(spec: SketchSpec, state: SketchState):
+    """Fetch a window plan from a live state -> (lo_w, n_w, w_t, with_neg).
+
+    ONE device round trip: the three plan scalars (global occupied min/max,
+    any-negative-mass flag) fold in a single jitted reduce and come back in
+    one ``device_get`` -- per-scalar fetches would pay the host-sync floor
+    three times per state mutation.
+    """
+    global _PLAN_STATS
+    if _PLAN_STATS is None:
+        _PLAN_STATS = jax.jit(
+            lambda lo, hi, nt: jnp.stack(
+                [
+                    jnp.min(lo),
+                    jnp.max(hi),
+                    jnp.max((nt > 0).astype(jnp.int32)),
+                ]
+            )
+        )
+    glo, ghi, neg_any = jax.device_get(
+        _PLAN_STATS(state.occ_lo, state.occ_hi, state.neg_total)
+    )
+    lo_w, n_w, w_t = plan_window(spec, int(glo), int(ghi))
+    return lo_w, n_w, w_t, bool(neg_any)
+
+
+def fused_quantile_windowed(
+    spec: SketchSpec,
+    state: SketchState,
+    qs: jax.Array,
+    lo_wblock,
+    *,
+    n_wblocks: int,
+    w_tiles: int = 1,
+    with_neg: bool = True,
+    block_streams: int = 0,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-quantile query reading only the occupied bin window.
+
+    The window is ``n_wblocks`` column blocks of ``w_tiles`` 128-bin tiles
+    starting at block index ``lo_wblock`` (traced scalar/[1] i32 -- one
+    compilation serves every window position); the caller guarantees every
+    occupied bin of every stream lies inside it -- exactly what the state's
+    ``occ_lo/occ_hi`` invariant certifies after a global fold, and what
+    :func:`plan_window` computes.  With ``with_neg=False`` the negative
+    store is not even read (its emptiness is certified by
+    ``state.neg_total == 0``), halving HBM traffic on positive-only
+    workloads.  HBM bytes scale with the occupied span instead of
+    ``n_bins`` (VERDICT r3 item 1c).
+
+    Semantics match :func:`batched.quantile` exactly on the certified
+    window (parity-tested across spans, stores, and empty streams).
+    """
+    n = state.n_streams
+    if spec.bins_integer:
+        raise NotImplementedError(
+            "windowed quantile requires float bins; integer-bin specs query"
+            " via batched.quantile (the facades route this automatically)"
+        )
+    qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
+    q_total = qs.shape[0]
+    if q_total == 0:
+        return jnp.zeros((n, 0), jnp.float32)
+    bn = block_streams or next(
+        (b for b in (512, 256, 128) if n % b == 0), _BN
+    )
+    if n % bn != 0:
+        # An oversized stream block would silently read past the arrays
+        # (garbage, not an error, on both TPU and interpret backends).
+        raise ValueError(
+            f"n_streams={n} must be a multiple of the stream block"
+            f" ({bn}); pad the batch or pass block_streams"
+        )
+    lo_tile = jnp.reshape(jnp.asarray(lo_wblock, jnp.int32), (1,))
+
+    # Pre-packed per-stream thresholds (one XLA pass over [N] vectors --
+    # negligible next to the bins read): pos_rank | rev_rank + 1 | key lo.
+    # key_offset rides as f32 (exact for |k| < 2**24, far beyond any real
+    # window position).
+    neg_count = state.neg_total.astype(jnp.float32)[:, None]
+    rank = qs[None, :] * (state.count.astype(jnp.float32)[:, None] - 1.0)
+    pos_rank = rank - state.zero_count.astype(jnp.float32)[:, None] - neg_count
+    rev_p1 = neg_count - rank
+    f32col = lambda x: x.astype(jnp.float32)[:, None]
+    packed = jnp.concatenate(
+        [
+            pos_rank, rev_p1, f32col(state.key_offset),
+            f32col(state.pos_lo), f32col(state.pos_hi),
+            f32col(state.neg_lo), f32col(state.neg_hi),
+        ],
+        axis=1,
+    )
+
+    tile_spec = pl.BlockSpec(
+        (bn, w_tiles * LO), lambda i, j, lo: (i, lo[0] + j)
+    )
+    in_specs = [tile_spec] + ([tile_spec] if with_neg else []) + [
+        pl.BlockSpec((bn, 2 * q_total + 5), lambda i, j, lo: (i, 0)),
+    ]
+    operands = [state.bins_pos] + (
+        [state.bins_neg] if with_neg else []
+    ) + [packed]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // bn, n_wblocks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bn, q_total), lambda i, j, lo: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bn, 2), jnp.float32),        # prefix carries
+            pltpu.VMEM((bn, 2 * q_total), jnp.float32),  # rank counts
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _windowed_kernel,
+            spec=spec,
+            w_tiles=w_tiles,
+            with_neg=with_neg,
+            q_total=q_total,
+            bn=bn,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, q_total), jnp.float32),
+        interpret=interpret,
+    )(lo_tile, *operands)
+    # Validity (q in [0, 1], non-empty stream) applies outside the kernel:
+    # one fused elementwise pass over the [N, Q] result.
+    valid = jnp.logical_and(
+        jnp.logical_and(qs >= 0.0, qs <= 1.0)[None, :],
+        (state.count > 0)[:, None],
+    )
+    return jnp.where(valid, out, jnp.nan)
+
+
 def add(
     spec: SketchSpec,
     state: SketchState,
@@ -574,13 +945,19 @@ def add(
     else:
         w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
 
-    (
-        hist_pos, hist_neg, zero, count, total, vmin, vmax, clow, chigh,
-        olo, ohi, negc,
-    ) = ingest_histogram(
+    hist_pos, hist_neg, cols = ingest_histogram(
         spec, v, w, state.key_offset,
         weighted=weights is not None, interpret=interpret,
     )
+    col = lambda name: cols[:, _COL[name]]
+    zero, count, total = col("zero"), col("count"), col("sum")
+    vmin, vmax = col("min"), col("max")
+    clow, chigh = col("clow"), col("chigh")
+    plo = col("pos_lo").astype(jnp.int32)
+    phi = col("pos_hi").astype(jnp.int32)
+    nlo = col("neg_lo").astype(jnp.int32)
+    nhi = col("neg_hi").astype(jnp.int32)
+    negc = col("neg_total")
     # The kernel emits f32 per-call deltas; accumulation into the state
     # happens here in the state's own bin dtype.  For integer-bin specs the
     # guards above make every delta an exact integer below 2**24, so the
@@ -589,15 +966,17 @@ def add(
     return SketchState(
         bins_pos=state.bins_pos + hist_pos.astype(bd),
         bins_neg=state.bins_neg + hist_neg.astype(bd),
-        zero_count=state.zero_count + zero[:, 0].astype(bd),
-        count=state.count + count[:, 0].astype(bd),
-        sum=state.sum + total[:, 0],
-        min=jnp.minimum(state.min, vmin[:, 0]),
-        max=jnp.maximum(state.max, vmax[:, 0]),
-        collapsed_low=state.collapsed_low + clow[:, 0].astype(bd),
-        collapsed_high=state.collapsed_high + chigh[:, 0].astype(bd),
+        zero_count=state.zero_count + zero.astype(bd),
+        count=state.count + count.astype(bd),
+        sum=state.sum + total,
+        min=jnp.minimum(state.min, vmin),
+        max=jnp.maximum(state.max, vmax),
+        collapsed_low=state.collapsed_low + clow.astype(bd),
+        collapsed_high=state.collapsed_high + chigh.astype(bd),
         key_offset=state.key_offset,
-        occ_lo=jnp.minimum(state.occ_lo, olo[:, 0]),
-        occ_hi=jnp.maximum(state.occ_hi, ohi[:, 0]),
-        neg_total=state.neg_total + negc[:, 0].astype(bd),
+        pos_lo=jnp.minimum(state.pos_lo, plo),
+        pos_hi=jnp.maximum(state.pos_hi, phi),
+        neg_lo=jnp.minimum(state.neg_lo, nlo),
+        neg_hi=jnp.maximum(state.neg_hi, nhi),
+        neg_total=state.neg_total + negc.astype(bd),
     )

@@ -264,10 +264,11 @@ class NativeDDSketch:
         pos, neg = self.bins()
         c = self._counters()
         as_row = lambda x: jnp.asarray(x, jnp.float32)[None]
-        occ = np.logical_or(pos > 0, neg > 0)
-        iota = np.arange(self.n_bins, dtype=np.int32)
-        occ_lo = int(np.where(occ, iota, self.n_bins).min())
-        occ_hi = int(np.where(occ, iota, -1).max())
+        from sketches_tpu.batched import occupied_bounds_np
+
+        (pos_lo, pos_hi), (neg_lo, neg_hi) = (
+            occupied_bounds_np(pos), occupied_bounds_np(neg)
+        )
         return SketchState(
             bins_pos=as_row(pos),
             bins_neg=as_row(neg),
@@ -279,8 +280,10 @@ class NativeDDSketch:
             collapsed_low=jnp.asarray([c[5]], jnp.float32),
             collapsed_high=jnp.asarray([c[6]], jnp.float32),
             key_offset=jnp.asarray([self.key_offset], jnp.int32),
-            occ_lo=jnp.asarray([occ_lo], jnp.int32),
-            occ_hi=jnp.asarray([occ_hi], jnp.int32),
+            pos_lo=jnp.asarray([pos_lo], jnp.int32),
+            pos_hi=jnp.asarray([pos_hi], jnp.int32),
+            neg_lo=jnp.asarray([neg_lo], jnp.int32),
+            neg_hi=jnp.asarray([neg_hi], jnp.int32),
             neg_total=jnp.asarray([neg.sum()], jnp.float32),
         )
 

@@ -137,8 +137,10 @@ def psum_merge(state: SketchState, axis_name: str) -> SketchState:
         # pmax is the identity fold that also lets shard_map's replication
         # checker prove the output is replicated over the value axis.
         key_offset=lax.pmax(state.key_offset, axis_name),
-        occ_lo=lax.pmin(state.occ_lo, axis_name),
-        occ_hi=lax.pmax(state.occ_hi, axis_name),
+        pos_lo=lax.pmin(state.pos_lo, axis_name),
+        pos_hi=lax.pmax(state.pos_hi, axis_name),
+        neg_lo=lax.pmin(state.neg_lo, axis_name),
+        neg_hi=lax.pmax(state.neg_hi, axis_name),
         neg_total=lax.psum(state.neg_total, axis_name),
     )
 
@@ -150,7 +152,7 @@ def _state_pspec(value_axis: Optional[str], stream_axis: Optional[str]) -> Sketc
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
         min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
-        occ_lo=p1, occ_hi=p1, neg_total=p1,
+        pos_lo=p1, pos_hi=p1, neg_lo=p1, neg_hi=p1, neg_total=p1,
     )
 
 
@@ -160,7 +162,7 @@ def _merged_pspec(stream_axis: Optional[str]) -> SketchState:
     return SketchState(
         bins_pos=p2, bins_neg=p2, zero_count=p1, count=p1, sum=p1,
         min=p1, max=p1, collapsed_low=p1, collapsed_high=p1, key_offset=p1,
-        occ_lo=p1, occ_hi=p1, neg_total=p1,
+        pos_lo=p1, pos_hi=p1, neg_lo=p1, neg_hi=p1, neg_total=p1,
     )
 
 
@@ -311,8 +313,20 @@ class DistributedDDSketch:
                     out_specs=P(stream_axis, None),
                 )
             )
+            # Windowed variant: the plan (occupied span + store
+            # participation) is GLOBAL -- folded from every shard's bound
+            # counters with one tiny host fetch -- so each chip reads only
+            # the occupied slice of its own shard.  Jits cache per plan
+            # shape; a sliding window recompiles nothing.
+            self._windowed_jits = {}
+            self._smap = smap
+            self._merged_pspec_ = merged_spec
+            self._interpret = interpret
+            self._n_local_streams = n_local_streams if divisible else 0
         else:
             self._quantile = jax.jit(functools.partial(quantile, spec))
+            self._windowed_jits = None
+        self._window_plan = None
         self._merge_partials = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
@@ -353,6 +367,7 @@ class DistributedDDSketch:
             weights = jnp.broadcast_to(weights, values.shape)
             self.partials = self._ingest(self.partials, values, weights)
         self._merged_cache = None
+        self._window_plan = None
         return self
 
     def merged_state(self) -> SketchState:
@@ -365,11 +380,49 @@ class DistributedDDSketch:
             self._merged_cache = self._fold(self.partials)
         return self._merged_cache
 
+    def _query_fn(self, q_total: int):
+        """Windowed per-shard query when eligible; full-window otherwise."""
+        if self._windowed_jits is None:
+            return self._quantile
+        from sketches_tpu import kernels
+
+        if self._window_plan is None:
+            self._window_plan = kernels.plan_state_window(
+                self.spec, self.merged_state()
+            )
+        lo_w, n_w, w_t, with_neg = self._window_plan
+        n_local = self._n_local_streams
+        bn = next((b for b in (512, 256, 128) if n_local % b == 0), 128)
+        key = (n_w, w_t, with_neg, q_total)
+        fn = self._windowed_jits.get(key)
+        if fn is None:
+            spec = self.spec
+            interpret = self._interpret
+
+            def local_windowed(st_, qs_, lo_):
+                return kernels.fused_quantile_windowed(
+                    spec, st_, qs_, lo_,
+                    n_wblocks=n_w, w_tiles=w_t, with_neg=with_neg,
+                    block_streams=bn, interpret=interpret,
+                )
+
+            fn = jax.jit(
+                self._smap(
+                    local_windowed,
+                    in_specs=(self._merged_pspec_, P(), P()),
+                    out_specs=P(self.stream_axis, None),
+                )
+            )
+            self._windowed_jits[key] = fn
+        lo_arr = jnp.asarray([lo_w], jnp.int32)
+        return lambda state, qs: fn(state, qs, lo_arr)
+
     def get_quantile_value(self, q: float) -> jax.Array:
-        return self._quantile(self.merged_state(), jnp.asarray([q]))[:, 0]
+        return self._query_fn(1)(self.merged_state(), jnp.asarray([q]))[:, 0]
 
     def get_quantile_values(self, qs: Sequence[float]) -> jax.Array:
-        return self._quantile(self.merged_state(), jnp.asarray(list(qs)))
+        qs = list(qs)
+        return self._query_fn(len(qs))(self.merged_state(), jnp.asarray(qs))
 
     def merge(self, other: "DistributedDDSketch") -> "DistributedDDSketch":
         """Fold another distributed batch into this one (elementwise, no comms)."""
@@ -381,6 +434,7 @@ class DistributedDDSketch:
             )
         self.partials = self._merge_partials(self.partials, other.partials)
         self._merged_cache = None
+        self._window_plan = None
         return self
 
     def to_batched(self) -> BatchedDDSketch:

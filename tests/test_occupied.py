@@ -35,22 +35,29 @@ from sketches_tpu.batched import (
 
 
 def assert_invariants(spec, state, *, weighted=False):
-    bp = np.asarray(state.bins_pos)
-    bn = np.asarray(state.bins_neg)
-    occ = np.logical_or(bp > 0, bn > 0)
+    bn_arr = np.asarray(state.bins_neg)
     iota = np.arange(spec.n_bins)
-    true_lo = np.where(occ, iota, spec.n_bins).min(axis=-1)
-    true_hi = np.where(occ, iota, -1).max(axis=-1)
-    olo = np.asarray(state.occ_lo)
-    ohi = np.asarray(state.occ_hi)
-    # Conservative superset: bounds may be wider, never narrower.
-    assert (olo <= true_lo).all(), (olo, true_lo)
-    assert (ohi >= true_hi).all(), (ohi, true_hi)
-    # Sentinels stay in-range.
-    assert (olo >= 0).all() and (olo <= spec.n_bins).all()
-    assert (ohi >= -1).all() and (ohi <= spec.n_bins - 1).all()
+    for bins, lo, hi in (
+        (np.asarray(state.bins_pos), state.pos_lo, state.pos_hi),
+        (bn_arr, state.neg_lo, state.neg_hi),
+    ):
+        occ = bins > 0
+        true_lo = np.where(occ, iota, spec.n_bins).min(axis=-1)
+        true_hi = np.where(occ, iota, -1).max(axis=-1)
+        lo, hi = np.asarray(lo), np.asarray(hi)
+        # Conservative superset: bounds may be wider, never narrower.
+        assert (lo <= true_lo).all(), (lo, true_lo)
+        assert (hi >= true_hi).all(), (hi, true_hi)
+        # Sentinels stay in-range.
+        assert (lo >= 0).all() and (lo <= spec.n_bins).all()
+        assert (hi >= -1).all() and (hi <= spec.n_bins - 1).all()
+    # Combined-window properties fold the per-store bounds.
+    np.testing.assert_array_equal(
+        np.asarray(state.occ_lo),
+        np.minimum(np.asarray(state.pos_lo), np.asarray(state.neg_lo)),
+    )
     neg = np.asarray(state.neg_total, np.float64)
-    ref = bn.sum(axis=-1, dtype=np.float64)
+    ref = bn_arr.sum(axis=-1, dtype=np.float64)
     if weighted:
         np.testing.assert_allclose(neg, ref, rtol=1e-5, atol=1e-4)
     else:
@@ -68,6 +75,10 @@ def _values(n, s, seed=0):
 def test_init_sentinels():
     spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
     st = init(spec, 4)
+    for f in ("pos_lo", "neg_lo"):
+        assert (np.asarray(getattr(st, f)) == 128).all()
+    for f in ("pos_hi", "neg_hi"):
+        assert (np.asarray(getattr(st, f)) == -1).all()
     assert (np.asarray(st.occ_lo) == 128).all()
     assert (np.asarray(st.occ_hi) == -1).all()
     assert (np.asarray(st.neg_total) == 0).all()
@@ -97,8 +108,10 @@ def test_pallas_parity_bounds():
     v = jnp.asarray(_values(128, 128))
     ref = add(spec, init(spec, 128), v)
     got = kernels.add(spec, init(spec, 128), v, interpret=True)
-    np.testing.assert_array_equal(np.asarray(got.occ_lo), np.asarray(ref.occ_lo))
-    np.testing.assert_array_equal(np.asarray(got.occ_hi), np.asarray(ref.occ_hi))
+    for f in ("pos_lo", "pos_hi", "neg_lo", "neg_hi"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f)), np.asarray(getattr(ref, f))
+        )
     np.testing.assert_allclose(
         np.asarray(got.neg_total), np.asarray(ref.neg_total), rtol=1e-6
     )
@@ -145,16 +158,20 @@ def test_checkpoint_backcompat_derives_bounds(tmp_path):
         kept = {
             k: data[k]
             for k in data.files
-            if k not in ("occ_lo", "occ_hi", "neg_total")
+            if k
+            not in ("pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total")
         }
     with open(path, "wb") as f:
         np.savez_compressed(f, **kept)
     spec2, st2 = checkpoint.restore_state(str(path))
     assert_invariants(spec2, st2)
     # Derivation from bins is exact, not just conservative.
-    olo, ohi = _occupied_bounds(st2.bins_pos, st2.bins_neg)
-    np.testing.assert_array_equal(np.asarray(st2.occ_lo), np.asarray(olo))
-    np.testing.assert_array_equal(np.asarray(st2.occ_hi), np.asarray(ohi))
+    plo, phi = _occupied_bounds(st2.bins_pos)
+    nlo, nhi = _occupied_bounds(st2.bins_neg)
+    np.testing.assert_array_equal(np.asarray(st2.pos_lo), np.asarray(plo))
+    np.testing.assert_array_equal(np.asarray(st2.pos_hi), np.asarray(phi))
+    np.testing.assert_array_equal(np.asarray(st2.neg_lo), np.asarray(nlo))
+    np.testing.assert_array_equal(np.asarray(st2.neg_hi), np.asarray(nhi))
 
 
 def test_distributed_psum_folds_bounds():
