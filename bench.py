@@ -74,22 +74,40 @@ def dispatch_floor_s() -> float:
     return _DISPATCH_FLOOR_S
 
 
-def fused_per_iter_s(body, init_acc, iters: int, reps: int = 3) -> float:
-    """Device-sustained seconds per iteration of ``body(i, acc) -> acc``.
+def fused_per_iter_s(body, init_acc, iters: int, reps: int = 3, args=()) -> float:
+    """Device-sustained seconds per iteration of ``body(i, acc, *args) -> acc``.
 
     Chains ``iters`` body runs in ONE jit dispatch (``lax.fori_loop``) and
     subtracts the measured dispatch floor, so the number is the cost the
     hardware itself sustains.  The body must depend on ``i`` in a way that
     survives algebraic simplification, or XLA hoists it out of the loop.
+
+    Every device array the body touches MUST ride in ``args`` (or
+    ``init_acc``), never in the closure: closed-over arrays become
+    captured lowering *constants* -- multi-GB literals shipped through the
+    compile path (measured: it alone stalled the benchmark for minutes).
     """
     import jax
 
-    f = jax.jit(lambda a: jax.lax.fori_loop(0, iters, body, a))
-    _sync(f(init_acc))  # compile + warm
+    f = jax.jit(
+        lambda a, *xs: jax.lax.fori_loop(
+            0, iters, lambda i, acc: body(i, acc, *xs), a
+        )
+    )
+
+    def run_and_sync():
+        # Sync on a ONE-element token, never the full result: a pytree acc
+        # (e.g. a whole sketch state) device_get would drag hundreds of MB
+        # through the tunnel per rep and bury the measurement (measured
+        # 400x on the merge config).
+        r = f(init_acc, *args)
+        _sync(jax.tree.leaves(r)[0].ravel()[:1])
+
+    run_and_sync()  # compile + warm
     best = 1e9
     for _ in range(reps):
         t0 = time.perf_counter()
-        _sync(f(init_acc))
+        run_and_sync()
         best = min(best, time.perf_counter() - t0)
     return max(best - dispatch_floor_s(), 0.0) / iters
 
@@ -250,16 +268,22 @@ def _device_bench(
     qs = jnp.asarray(QS4, dtype=jnp.float32)
     q_iters = max(16, 2 * fused_k)
 
-    def _q_body(i, acc):
-        return acc + q_fn(state, qs * (1.0 - i.astype(jnp.float32) * 1e-4)).sum()
+    def _q_body(i, acc, st_, qs_):
+        return acc + q_fn(st_, qs_ * (1.0 - i.astype(jnp.float32) * 1e-4)).sum()
 
-    fq = jax.jit(lambda a: jax.lax.fori_loop(0, q_iters, _q_body, a))
-    _sync(fq(jnp.float32(0.0)))
+    # state/qs ride as jit ARGS -- closure capture would embed the 4.3 GB
+    # state as lowering constants (see fused_per_iter_s).
+    fq = jax.jit(
+        lambda a, st_, qs_: jax.lax.fori_loop(
+            0, q_iters, lambda i, acc: _q_body(i, acc, st_, qs_), a
+        )
+    )
+    _sync(fq(jnp.float32(0.0), state, qs))
     floor = dispatch_floor_s()
     lat = []
     for _ in range(8):
         t0 = time.perf_counter()
-        _sync(fq(jnp.float32(0.0)))
+        _sync(fq(jnp.float32(0.0), state, qs))
         lat.append(max(time.perf_counter() - t0 - floor, 0.0) / q_iters)
     lat = np.asarray(lat)
 
@@ -324,11 +348,11 @@ def bench_membw(skip_1m: bool = False):
         )
         a, b = gen(jax.random.PRNGKey(0)), gen(jax.random.PRNGKey(1))
 
-        def body(i, acc):
+        def body(i, acc, a_, b_):
             c = i.astype(jnp.float32) * 1e-9
-            return acc + jnp.maximum(a, c).sum() + jnp.maximum(b, c).sum()
+            return acc + jnp.maximum(a_, c).sum() + jnp.maximum(b_, c).sum()
 
-        dt = fused_per_iter_s(body, jnp.float32(0.0), iters)
+        dt = fused_per_iter_s(body, jnp.float32(0.0), iters, args=(a, b))
         return {
             "gb": round(nbytes / 1e9, 3),
             "read_s": round(dt, 6),
@@ -367,20 +391,28 @@ def bench_shard_query(profile: bool):
     add_fn = functools.partial(kernels.add if use_pallas else add, spec)
 
     def one_case(sigma):
+        from sketches_tpu.batched import auto_offset, recenter
+
         values = jax.jit(
             lambda k: jnp.exp(
                 jnp.float32(sigma) * jax.random.normal(k, (n, batch), jnp.float32)
             )
         )(jax.random.PRNGKey(0))
-        state = jax.jit(add_fn, donate_argnums=0)(init(spec, n), values)
+        # Facade-equivalent auto-centering: the window plan (and therefore
+        # the bytes the query reads) depends on where the first batch
+        # centers each stream's window.
+        st0 = init(spec, n)
+        st0 = recenter(spec, st0, auto_offset(spec, st0, values))
+        state = jax.jit(add_fn, donate_argnums=0)(st0, values)
         _sync(state.count[:1])
         qs = jnp.asarray(QS4, jnp.float32)
         q_fn, plan = _windowed_query_fn(spec, state, use_pallas)
         query_s = fused_per_iter_s(
-            lambda i, acc: acc
-            + q_fn(state, qs * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
+            lambda i, acc, st_, qs_: acc
+            + q_fn(st_, qs_ * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
             jnp.float32(0.0),
             iters=64,
+            args=(state, qs),
         )
         return state, {
             "query_sustained_s": round(query_s, 6),
@@ -391,27 +423,33 @@ def bench_shard_query(profile: bool):
         # Worst case: a window-filling distribution (sigma=1.5 spans the
         # whole 512-bin window) -- every bin byte must stream.
         state, wide = one_case(1.5)
-        # Realistic telemetry: concentrated positive values (span <= 2 of
-        # 4 window tiles) -- the windowed plan reads only the occupied
-        # slice of one store.
-        _, conc = one_case(0.3)
+        # Mid occupancy: lognormal sigma=0.3 (~35x value spread) spans 3
+        # of 4 window tiles.
+        _, mid = one_case(0.3)
+        # Tight telemetry: sigma=0.1 (~6x value spread) fits ONE column
+        # tile -- the sub-ms regime (tile-midpoint auto-centering keeps it
+        # from straddling a tile boundary).
+        _, tight = one_case(0.1)
 
         # Per-shard merge compute: fold a second state in, iterated.  The
         # accumulating carry is the merge output, so every iteration reads
         # both operands and writes the result (the psum's local compute).
         merge_fn = functools.partial(merge, spec)
 
-        def m_body(i, acc):
-            return merge_fn(acc, state)
+        def m_body(i, acc, st_):
+            return merge_fn(acc, st_)
 
-        merge_s = fused_per_iter_s(m_body, init(spec, n), iters=32)
+        merge_s = fused_per_iter_s(
+            m_body, init(spec, n), iters=32, args=(state,)
+        )
 
     return {
         "engine": "pallas" if use_pallas else "xla",
         "n_streams": n,
         "state_gb": round(2 * n * 512 * 4 / 1e9, 3),
         "wide_window": wide,
-        "concentrated": conc,
+        "mid_occupancy": mid,
+        "tight_telemetry": tight,
         "merge_per_shard_s": round(merge_s, 6),
     }
 

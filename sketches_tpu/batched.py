@@ -709,6 +709,23 @@ def merge_aligned(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchSta
     return merge(spec, recenter(spec, a, target), recenter(spec, b, target))
 
 
+def _center_bin(spec: SketchSpec) -> int:
+    """The bin auto-centering targets: the *midpoint of a 128-bin tile*.
+
+    ``n_bins // 2`` itself is a tile boundary (128 | n_bins), so centering
+    a tight distribution there makes its occupancy straddle two of the
+    windowed query's column tiles and double its HBM read.  Nudging the
+    target to the adjacent tile midpoint keeps any span <= 128 bins inside
+    ONE tile (measured: the straddle costs ~2x query latency on
+    concentrated telemetry) at the cost of 64 bins of asymmetric headroom
+    -- irrelevant to collapse behavior at 512+ bins.  Narrow windows
+    (< 512 bins) keep the symmetric center: they span few tiles anyway and
+    64 bins of lost headroom would matter.
+    """
+    half = spec.n_bins // 2
+    return half - 64 if spec.n_bins >= 512 else half
+
+
 def auto_offset(
     spec: SketchSpec,
     state: SketchState,
@@ -742,7 +759,7 @@ def auto_offset(
     n_live = nonzero.sum(-1)  # [N]
     mid = jnp.maximum((n_live - 1) // 2, 0)
     med = jnp.take_along_axis(ksort, mid[:, None].astype(jnp.int32), axis=-1)[:, 0]
-    centered = med - jnp.int32(spec.n_bins // 2)
+    centered = med - jnp.int32(_center_bin(spec))
     return jnp.where(n_live > 0, centered, state.key_offset).astype(jnp.int32)
 
 
@@ -766,7 +783,7 @@ def recenter_to_data(spec: SketchSpec, state: SketchState) -> SketchState:
     center = (cum < total[:, None] * 0.5).sum(-1).astype(jnp.int32)
     new_off = jnp.where(
         total > 0,
-        state.key_offset + center - jnp.int32(spec.n_bins // 2),
+        state.key_offset + center - jnp.int32(_center_bin(spec)),
         state.key_offset,
     )
     return recenter(spec, state, new_off)
@@ -967,9 +984,6 @@ class BatchedDDSketch:
                 self.spec, self.state
             )
         lo_w, n_w, w_t, with_neg = self._window_plan
-        bn = next(
-            (b for b in (512, 256, 128) if self.n_streams % b == 0), 128
-        )
         key = (n_w, w_t, with_neg, q_total)
         fn = self._windowed_jits.get(key)
         if fn is None:
@@ -980,7 +994,6 @@ class BatchedDDSketch:
                     n_wblocks=n_w,
                     w_tiles=w_t,
                     with_neg=with_neg,
-                    block_streams=bn,
                     interpret=self._interpret,
                 )
             )

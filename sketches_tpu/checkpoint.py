@@ -77,14 +77,14 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
         if "pos_lo" not in arrays:
             from sketches_tpu.batched import occupied_bounds_np
 
-            for name, bins in (
-                ("pos", np.asarray(data["bins_pos"])),
-                ("neg", np.asarray(data["bins_neg"])),
-            ):
+            # Materialize each compressed array once (npz re-decompresses
+            # on every access).
+            bp = np.asarray(data["bins_pos"])
+            bn = np.asarray(data["bins_neg"])
+            for name, bins in (("pos", bp), ("neg", bn)):
                 lo, hi = occupied_bounds_np(bins)
                 arrays[f"{name}_lo"] = jnp.asarray(lo)
                 arrays[f"{name}_hi"] = jnp.asarray(hi)
-            bn = np.asarray(data["bins_neg"])
             arrays["neg_total"] = jnp.asarray(
                 bn.sum(axis=-1).astype(bn.dtype)
             )

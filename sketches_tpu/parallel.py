@@ -391,8 +391,6 @@ class DistributedDDSketch:
                 self.spec, self.merged_state()
             )
         lo_w, n_w, w_t, with_neg = self._window_plan
-        n_local = self._n_local_streams
-        bn = next((b for b in (512, 256, 128) if n_local % b == 0), 128)
         key = (n_w, w_t, with_neg, q_total)
         fn = self._windowed_jits.get(key)
         if fn is None:
@@ -400,10 +398,12 @@ class DistributedDDSketch:
             interpret = self._interpret
 
             def local_windowed(st_, qs_, lo_):
+                # block_streams stays at the kernel's own default policy,
+                # judged on the shard-local stream count it actually sees.
                 return kernels.fused_quantile_windowed(
                     spec, st_, qs_, lo_,
                     n_wblocks=n_w, w_tiles=w_t, with_neg=with_neg,
-                    block_streams=bn, interpret=interpret,
+                    interpret=interpret,
                 )
 
             fn = jax.jit(

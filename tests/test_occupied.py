@@ -56,6 +56,10 @@ def assert_invariants(spec, state, *, weighted=False):
         np.asarray(state.occ_lo),
         np.minimum(np.asarray(state.pos_lo), np.asarray(state.neg_lo)),
     )
+    np.testing.assert_array_equal(
+        np.asarray(state.occ_hi),
+        np.maximum(np.asarray(state.pos_hi), np.asarray(state.neg_hi)),
+    )
     neg = np.asarray(state.neg_total, np.float64)
     ref = bn_arr.sum(axis=-1, dtype=np.float64)
     if weighted:
