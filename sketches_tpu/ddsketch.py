@@ -222,7 +222,13 @@ class JaxDDSketch(BaseDDSketch):
     device path underneath).  Scalar ``add`` calls buffer on the host and
     flush to a 1-stream slice of the batched device state in fixed-size
     chunks (fixed so one jit compilation serves every flush); queries and
-    merges flush first.  Scalar bookkeeping (count/sum/min/max) stays in
+    merges flush first.
+
+    Throughput note (measured, BENCH r3): a scalar add loop through this
+    facade runs ~7x SLOWER than the pure-Python host tier (~0.16 M vs
+    ~1.2 M add/s) -- the per-flush device dispatch dominates.  The jax
+    backend exists for *batched* multi-stream throughput; keep scalar
+    single-stream workloads on ``DDSketch``/``NativeDDSketch``.  Scalar bookkeeping (count/sum/min/max) stays in
     host float64 -- strictly more precise than the reference's -- while bin
     mass lives on device in float32, which accumulates exactly only up to
     2**24 (~16.7M) mass per bin (see ``SketchSpec.dtype``).
