@@ -180,7 +180,6 @@ def _ingest_kernel(
     lo = idx % LO
 
     bn, bs = v.shape
-    dims = (((2,), (1,)), ((0,), (0,)))  # contract s; batch n
 
     bn_rows = values_ref.shape[0]
 
@@ -223,9 +222,17 @@ def _ingest_kernel(
     # Blocks wider than _BS process in _BS-value sub-chunks: one-hot
     # operands are built (and die) per sub-chunk, so peak VMEM stays at the
     # narrow-block level while the grid-iteration count still shrinks.
+    #
+    # BOTH one-hots lay the value axis on the LANES ([.., ., _BS], iota
+    # over the sublane dim) and the matmul contracts the last dims of both
+    # operands ("NT" form).  The earlier [BN, _BS, LO] lo one-hot --
+    # values on sublanes -- built the same bits 3.5x slower (measured:
+    # 153 -> 43 ms per 268M-value pass at 1M x 512); one-hot construction
+    # is ~95% of ingest, so the layout IS the throughput.
     n_terms = 3 if weighted else 1
     hi_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, 2 * hi_size, _BS), 1)
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, _BS, LO), 2)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, LO, _BS), 1)
+    nt_dims = (((2,), (2,)), ((0,), (0,)))  # contract lanes; batch streams
     c = jnp.zeros((bn, 2 * hi_size, LO), jnp.float32)
     for t in range(bs // _BS):
         # lax.slice_in_dim, not mixed None+slice getitem: the latter takes
@@ -234,12 +241,12 @@ def _ingest_kernel(
         lo_t = jax.lax.slice_in_dim(lo, t * _BS, (t + 1) * _BS, axis=1)
         w_t = jax.lax.slice_in_dim(signed, t * _BS, (t + 1) * _BS, axis=1)
         onehot_hi = (hi_t[:, None, :] == hi_iota).astype(jnp.bfloat16)
-        onehot_lo = (lo_t[:, :, None] == lo_iota).astype(jnp.bfloat16)
+        onehot_lo = (lo_t[:, None, :] == lo_iota).astype(jnp.bfloat16)
         for part in _exact_bf16_terms(w_t, n_terms):
             # bf16 multiply by a 0/1 one-hot is exact.
             a = onehot_hi * part[:, None, :]  # [BN, 2HI, _BS] bf16
             c = c + jax.lax.dot_general(
-                a, onehot_lo, dims, preferred_element_type=jnp.float32
+                a, onehot_lo, nt_dims, preferred_element_type=jnp.float32
             )  # [BN, 2HI, LO]
     c = c.reshape(bn, 2 * n_bins)
     hist_pos_ref[:] += c[:, :n_bins]
