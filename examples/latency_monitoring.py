@@ -63,6 +63,29 @@ def main():
     worst = int(np.argmax(q[:, 2]))
     print(f"worst p99: endpoint {worst} at {q[worst, 2]:.1f} ms")
 
+    # Observability counters the device tier maintains for free:
+    # - the occupied-window plan the query just used (bytes scale with
+    #   occupancy: tight latency distributions read one 128-bin tile of
+    #   one store instead of every bin -- docs/DESIGN.md section 3b);
+    # - collapsed mass (values that fell off the window edges);
+    # - overflow risk (largest accumulator vs the f32 exactness ceiling).
+    from sketches_tpu import kernels
+
+    lo_w, n_w, w_t, with_neg = kernels.plan_state_window(
+        fleet.spec, fleet.state
+    )
+    print(
+        f"query window plan: {n_w * w_t} of"
+        f" {fleet.spec.n_bins // 128} column tiles,"
+        f" negative store {'read' if with_neg else 'skipped (empty)'}"
+    )
+    collapsed = float(np.asarray(fleet.collapsed_fraction()).max())
+    _, risk = fleet.overflow_risk()
+    print(
+        f"max collapsed fraction: {collapsed:.2e};"
+        f" max overflow-risk fraction: {float(np.asarray(risk).max()):.2e}"
+    )
+
     # Interop: any single endpoint's sketch can round-trip through the
     # reference-compatible protobuf wire format for other-language readers.
     try:
