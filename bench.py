@@ -660,6 +660,18 @@ def verify_on_device():
             qb = np.asarray(quantile(spec, ref, qs))
             if not np.allclose(qa, qb, rtol=1e-4, equal_nan=True):
                 failures.append(f"{mapping}/w={weights is not None}/quantile")
+            # The production (windowed) query kernel, on real hardware with
+            # the plan the facades would derive -- interpret-mode parity in
+            # CI does not prove the Mosaic lowering.
+            lo_w, n_w, w_t, with_neg = kernels.plan_state_window(spec, got)
+            qw = np.asarray(
+                kernels.fused_quantile_windowed(
+                    spec, got, qs, lo_w,
+                    n_wblocks=n_w, w_tiles=w_t, with_neg=with_neg,
+                )
+            )
+            if not np.allclose(qw, qb, rtol=1e-4, equal_nan=True):
+                failures.append(f"{mapping}/w={weights is not None}/windowed")
     return "pass" if not failures else "FAIL: " + ",".join(failures)
 
 
