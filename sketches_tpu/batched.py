@@ -629,6 +629,64 @@ def overflow_risk(spec: SketchSpec, state: SketchState):
 # ---------------------------------------------------------------------------
 
 
+# Temp budget for stream-chunked ops: the recenter scatter and the ingest
+# kernel's histogram delta materialize O(chunk x n_bins) f32/int32
+# intermediates; 2**25 elements keeps each around 128 MB.  At 1M x 512 the
+# UNchunked scatter's temps alone are ~8.5 GB -- a 1M-stream merge_aligned
+# ran out of HBM outright (measured: "Used 16.57G of 15.75G hbm") before
+# chunking, and two live 1M facades left ingest no headroom either.
+_CHUNK_ELEMS = 1 << 25
+
+
+def _stream_chunk(n_streams: int, n_bins: int) -> int:
+    """Chunk length for bounded-memory stream chunking; 0 = don't chunk.
+
+    The SINGLE place the chunking policy lives.  Chunks are 128-aligned
+    (the Pallas engines' stream-block quantum: full chunks stay
+    kernel-eligible, and when ``n_streams`` is itself 128-aligned so is
+    the remainder chunk).  Chunking only engages when it buys at least a
+    2x temp reduction -- any stream count qualifies, remainder included
+    (1,000,000 = 7 x 131,072 + 82,432, not just powers of two).
+    """
+    target = max(128, (_CHUNK_ELEMS // max(n_bins, 1)) // 128 * 128)
+    if n_streams <= 2 * target:
+        return 0
+    return target
+
+
+def _map_stream_chunks(fn, n_streams: int, n_bins: int, *operands):
+    """Run a per-stream-independent op in bounded-memory stream chunks.
+
+    ``fn(*chunk_operands)`` maps over ``lax.map`` chunks of the leading
+    (stream) axis (XLA sequences them, bounding peak temp memory at one
+    chunk's worth), with a ragged tail handled by one direct call.  No-op
+    (direct call) when the whole batch fits the budget.
+    """
+    chunk = _stream_chunk(n_streams, n_bins)
+    if not chunk:
+        return fn(*operands)
+    k, rem = divmod(n_streams, chunk)
+    head = n_streams - rem
+
+    # Slice chunks INSIDE the mapped body (dynamic_slice per step), never
+    # via upfront reshape copies of the operands -- those would add a full
+    # state footprint per operand and defeat the bounded-memory goal.
+    def one_chunk(start):
+        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, start, chunk, axis=0)
+        return fn(*(jax.tree.map(sl, o) for o in operands))
+
+    out = jax.lax.map(one_chunk, jnp.arange(k, dtype=jnp.int32) * chunk)
+    out = jax.tree.map(
+        lambda x: x.reshape((head,) + x.shape[2:]), out
+    )
+    if not rem:
+        return out
+    tail = fn(*(jax.tree.map(lambda x: x[head:], o) for o in operands))
+    return jax.tree.map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), out, tail
+    )
+
+
 def recenter(
     spec: SketchSpec, state: SketchState, new_key_offset: jax.Array
 ) -> SketchState:
@@ -646,12 +704,24 @@ def recenter(
     ``collapsed_low/high`` are upper bounds on resolution-lost mass once a
     window has both collapsed and recentered.
 
-    Cost: one scatter-add pass per store (rare op; pair with the facade
-    policies rather than calling per batch).
+    Cost: one scatter-add pass per store, in bounded-memory stream chunks
+    (rare op; pair with the facade policies rather than calling per batch).
     """
     new_off = jnp.broadcast_to(
         jnp.asarray(new_key_offset, jnp.int32), state.key_offset.shape
     )
+    return _map_stream_chunks(
+        functools.partial(_recenter_body, spec),
+        state.n_streams,
+        spec.n_bins,
+        state,
+        new_off,
+    )
+
+
+def _recenter_body(
+    spec: SketchSpec, state: SketchState, new_off: jax.Array
+) -> SketchState:
     shift = new_off - state.key_offset  # [N]; new_idx = old_idx - shift
     n_bins = spec.n_bins
     iota = jnp.arange(n_bins, dtype=jnp.int32)
@@ -704,9 +774,26 @@ def merge_aligned(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchSta
     This is what the facades use: adaptive windows make equal offsets a
     runtime property, not a spec-level guarantee.
     """
-    a_binned = (a.count - a.zero_count) > 0
-    target = jnp.where(a_binned, a.key_offset, b.key_offset).astype(jnp.int32)
-    return merge(spec, recenter(spec, a, target), recenter(spec, b, target))
+    # Chunked over streams: the two recenter scatters' temps would
+    # otherwise stack on top of both full operands (OOM at 1M x 512).
+    return _map_stream_chunks(
+        functools.partial(_merge_aligned_body, spec), a.n_streams,
+        spec.n_bins, a, b,
+    )
+
+
+def _merge_aligned_body(
+    spec: SketchSpec, a_: SketchState, b_: SketchState
+) -> SketchState:
+    a_binned = (a_.count - a_.zero_count) > 0
+    target = jnp.where(a_binned, a_.key_offset, b_.key_offset).astype(
+        jnp.int32
+    )
+    return merge(
+        spec,
+        _recenter_body(spec, a_, jnp.broadcast_to(target, a_.key_offset.shape)),
+        _recenter_body(spec, b_, jnp.broadcast_to(target, b_.key_offset.shape)),
+    )
 
 
 def _center_bin(spec: SketchSpec) -> int:
@@ -837,15 +924,13 @@ class BatchedDDSketch:
 
         use_pallas, interpret = kernels.select_engine(spec, n_streams, engine)
         self.engine = "pallas" if use_pallas else "xla"
+        self._op_jits = {}
         # The XLA add stays available even on the Pallas engine: it takes
         # the non-128-aligned batch widths the kernels do not.
-        self._add_xla = jax.jit(
-            functools.partial(add, spec), donate_argnums=(0,)
-        )
+        self._add_xla = functools.partial(add, spec)
         if use_pallas:
-            self._add_pallas = jax.jit(
-                functools.partial(kernels.add, spec, interpret=interpret),
-                donate_argnums=(0,),
+            self._add_pallas = functools.partial(
+                kernels.add, spec, interpret=interpret
             )
             self._batch_ok = lambda s: kernels.supports(spec, n_streams, s)
         else:
@@ -873,20 +958,16 @@ class BatchedDDSketch:
         self._merge = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
-        self._merge_aligned = jax.jit(
-            functools.partial(merge_aligned, spec), donate_argnums=(0,)
-        )
+        self._merge_body = functools.partial(_merge_aligned_body, spec)
         # Derive-offsets-from-this-batch, recenter masked streams, ingest --
         # one dispatch.  Used for the first batch (mask = all streams) and
         # for maybe_recenter's armed follow-up (mask = drifting streams).
         def _recenter_add(st, values, weights, mask):
             offs = auto_offset(spec, st, values, weights)
-            st = recenter(
-                spec, st, jnp.where(mask, offs, st.key_offset)
-            )
+            st = recenter(spec, st, jnp.where(mask, offs, st.key_offset))
             return add(spec, st, values, weights)
 
-        self._add_recentering = jax.jit(_recenter_add, donate_argnums=(0,))
+        self._add_recentering = _recenter_add
         self._pending_recenter_mask: Optional[np.ndarray] = None
         # Collapse/binned-mass snapshots for maybe_recenter's delta test.
         self._policy_collapsed = np.zeros((n_streams,), np.float64)
@@ -928,7 +1009,7 @@ class BatchedDDSketch:
                 mask = jnp.asarray(self._pending_recenter_mask)
             self._auto_recenter_pending = False
             self._pending_recenter_mask = None
-            self.state = self._add_recentering(self.state, values, weights, mask)
+            self._stream_op("recenter_add", self._add_recentering, values, weights, mask)
             if armed_by_policy:
                 # Re-baseline the policy snapshots past the fold the armed
                 # recenter itself produced (old edge piles leaving the new
@@ -951,9 +1032,9 @@ class BatchedDDSketch:
             # kernels.add).
             and not (self.spec.bins_integer and weights is not None)
         ):
-            self.state = self._add_pallas(self.state, values, weights)
+            self._stream_op("add_pallas", self._add_pallas, values, weights)
         else:
-            self.state = self._add_xla(self.state, values, weights)
+            self._stream_op("add_xla", self._add_xla, values, weights)
         self._window_plan = None
         return self
 
@@ -1026,7 +1107,7 @@ class BatchedDDSketch:
             raise UnequalSketchParametersError(
                 "Cannot merge two batched sketches with different specs"
             )
-        self.state = self._merge_aligned(self.state, other.state)
+        self._stream_op("merge_aligned", self._merge_body, other.state)
         self._window_plan = None
         # A merge that brings mass populates the batch: a still-pending
         # first-batch auto-center would recenter away from that mass.  An
@@ -1035,6 +1116,66 @@ class BatchedDDSketch:
         if self._auto_recenter_pending and bool(jnp.any(other.state.count > 0)):
             self._auto_recenter_pending = False
         return self
+
+    def _stream_op(self, key, body, *args) -> None:
+        """``state <- body(state, *args)``, chunked over streams when large.
+
+        Full-batch device ops materialize O(n_streams x n_bins) temps (the
+        ingest kernel's histogram delta alone equals the state size, and a
+        whole-batch merge keeps THREE full states live -- measured OOM on
+        a 16 GB chip at 1M x 512 with two facades).  Big batches therefore
+        run as K dispatches, each slicing a stream chunk, applying
+        ``body``, and updating the donated full state in place; a ragged
+        tail runs as one extra dispatch at its own static width.  Small
+        batches keep the original single-dispatch graph.  ``args`` may be
+        arrays or pytrees (e.g. another SketchState); every leaf with a
+        leading stream axis is sliced per chunk, everything else passes
+        through whole.
+        """
+        chunk = _stream_chunk(self.n_streams, self.spec.n_bins)
+        if not chunk:
+            fn = self._op_jits.get(key)
+            if fn is None:
+                fn = jax.jit(body, donate_argnums=(0,))
+                self._op_jits[key] = fn
+            self.state = fn(self.state, *args)
+            return
+        n = self.n_streams
+
+        def make(chunk_len):
+            def chunked(full_state, start, *full_args):
+                sl = lambda x: jax.lax.dynamic_slice_in_dim(
+                    x, start, chunk_len, axis=0
+                )
+                sl_leaf = lambda x: (
+                    sl(x)
+                    if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n
+                    else x
+                )
+                out = body(
+                    jax.tree.map(sl, full_state),
+                    *(jax.tree.map(sl_leaf, a) for a in full_args),
+                )
+                upd = lambda x, u: jax.lax.dynamic_update_slice_in_dim(
+                    x, u, start, axis=0
+                )
+                return jax.tree.map(upd, full_state, out)
+
+            return jax.jit(chunked, donate_argnums=(0,))
+
+        k, rem = divmod(n, chunk)
+        fn = self._op_jits.get((key, chunk))
+        if fn is None:
+            fn = self._op_jits[(key, chunk)] = make(chunk)
+        st = self.state
+        for i in range(k):
+            st = fn(st, i * chunk, *args)
+        if rem:
+            fn_rem = self._op_jits.get((key, rem))
+            if fn_rem is None:
+                fn_rem = self._op_jits[(key, rem)] = make(rem)
+            st = fn_rem(st, k * chunk, *args)
+        self.state = st
 
     # -- adaptive window ---------------------------------------------------
     def recenter(self, new_key_offset) -> "BatchedDDSketch":

@@ -518,3 +518,71 @@ def test_merge_alignment_survives_state_rebuild(tmp_path):
         for j, q in enumerate(QS):
             exact = np.quantile(allv[i], q, method="lower")
             assert abs(got[i, j] - exact) <= 0.0101 * abs(exact), (i, q)
+
+
+def test_chunked_recenter_and_merge_parity(monkeypatch):
+    """Stream-chunked recenter/merge_aligned (the bounded-memory path that
+    keeps 1M-stream merges inside HBM) is bit-identical to the unchunked
+    graph."""
+    import sketches_tpu.batched as batched
+
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    n = 4352  # 4 x 1024 + a ragged 256-row tail under the forced budget
+    vals = np.random.RandomState(0).lognormal(0, 1.0, (n, 32)).astype(np.float32)
+    a = add(spec, init(spec, n), jnp.asarray(vals))
+    b = add(spec, init(spec, n), jnp.asarray(vals[:, ::-1] * 50.0))
+    ref_r = batched.recenter(spec, a, a.key_offset + 17)
+    ref_m = batched.merge_aligned(spec, a, b)
+    # Force chunking: budget 128*1024 elems at 128 bins -> chunk=1024,
+    # so n=4352 runs as 4 full chunks + a 256-row ragged tail.
+    monkeypatch.setattr(batched, "_CHUNK_ELEMS", 128 * 1024)
+    chunk = batched._stream_chunk(n, spec.n_bins)
+    assert 0 < chunk < n and n % chunk != 0  # ragged tail exercised
+    got_r = batched.recenter(spec, a, a.key_offset + 17)
+    got_m = batched.merge_aligned(spec, a, b)
+    for ref, got in ((ref_r, got_r), (ref_m, got_m)):
+        for f in (
+            "bins_pos", "bins_neg", "zero_count", "count", "sum", "min",
+            "max", "collapsed_low", "collapsed_high", "key_offset",
+            "pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total",
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, f)), np.asarray(getattr(ref, f)), f
+            )
+
+
+def test_chunked_facade_ops_parity(monkeypatch):
+    """Facade adds (auto-center + steady-state) and merges under forced
+    stream chunking match the single-dispatch graphs exactly."""
+    import sketches_tpu.batched as batched
+
+    n = 2176  # 8 x 256 + a ragged 128-row tail under the forced budget
+
+    def run():
+        a = batched.BatchedDDSketch(
+            n, relative_accuracy=0.01, n_bins=128, engine="xla"
+        )
+        v = np.random.RandomState(1).lognormal(0, 1, (n, 32)).astype(np.float32)
+        a.add(v)            # first add: auto-center path
+        a.add(v * 2.0)      # steady-state path
+        b = batched.BatchedDDSketch(
+            n, relative_accuracy=0.01, n_bins=128, engine="xla"
+        )
+        b.add(v * 100.0)
+        a.merge(b)          # alignment-safe merge path
+        return a
+
+    ref = run()
+    monkeypatch.setattr(batched, "_CHUNK_ELEMS", 32 * 1024)
+    chunk = batched._stream_chunk(n, 128)
+    assert 0 < chunk < n and n % chunk != 0  # ragged tail exercised
+    got = run()
+    for f in ("bins_pos", "bins_neg", "count", "key_offset", "pos_lo", "neg_hi"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got.state, f)), np.asarray(getattr(ref.state, f)), f
+        )
+    np.testing.assert_allclose(
+        np.asarray(got.get_quantile_values([0.5, 0.99])),
+        np.asarray(ref.get_quantile_values([0.5, 0.99])),
+        rtol=1e-6,
+    )
