@@ -771,8 +771,10 @@ def merge_aligned(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchSta
     constructed, auto-center still pending) batch adopts the occupied
     operand's window instead of dragging its mass back to the default
     window's edges.  Where offsets already agree the shifts are no-ops.
-    This is what the facades use: adaptive windows make equal offsets a
-    runtime property, not a spec-level guarantee.
+    This is the alignment-safe semantics every merge seam carries
+    (``BatchedDDSketch.merge`` streams the same body through its chunked
+    in-place dispatch): adaptive windows make equal offsets a runtime
+    property, not a spec-level guarantee.
     """
     # Chunked over streams: the two recenter scatters' temps would
     # otherwise stack on top of both full operands (OOM at 1M x 512).

@@ -180,6 +180,12 @@ class DistributedDDSketch:
     State layout: a stacked ``[n_value_shards, n_streams, n_bins]`` pytree,
     sharded ``P(value_axis, stream_axis, None)``.  Ingest donates it.
 
+    Memory note: per-shard ops materialize O(local_streams x n_bins)
+    temps without the batched facade's stream-chunked dispatch, so size
+    shards to leave headroom (a v5e-8 shard of a 1M-stream state is
+    537 MB -- comfortable); for a single-device million-stream batch use
+    ``BatchedDDSketch``, whose chunked ops bound residency.
+
     Engine note: like ``BatchedDDSketch``, the Pallas engine requires each
     *call's* per-shard value-batch width to be 128-aligned; an ``add`` whose
     width does not qualify silently takes the portable XLA scatter path for
