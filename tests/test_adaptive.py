@@ -586,3 +586,32 @@ def test_chunked_facade_ops_parity(monkeypatch):
         np.asarray(ref.get_quantile_values([0.5, 0.99])),
         rtol=1e-6,
     )
+
+
+def test_chunked_facade_pallas_engine_parity(monkeypatch):
+    """The chunked dispatch also preserves the Pallas engine's results
+    (chunks are 128-aligned, keeping every chunk kernel-eligible)."""
+    import sketches_tpu.batched as batched
+
+    n = 1536  # 4 x 256 + a ragged 512... -> with chunk 256: 6 full chunks
+    v = np.random.RandomState(2).lognormal(0, 1, (n, 128)).astype(np.float32)
+
+    def run():
+        a = batched.BatchedDDSketch(
+            n, relative_accuracy=0.01, n_bins=256, engine="pallas"
+        )
+        a.add(v)
+        a.add(v * 2.0)
+        return a
+
+    ref = run()
+    monkeypatch.setattr(batched, "_CHUNK_ELEMS", 64 * 1024)
+    chunk = batched._stream_chunk(n, 256)
+    assert 0 < chunk < n
+    got = run()
+    for f in ("bins_pos", "bins_neg", "count", "pos_lo", "pos_hi"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got.state, f)),
+            np.asarray(getattr(ref.state, f)),
+            f,
+        )
