@@ -443,7 +443,12 @@ def quantile(spec: SketchSpec, state: SketchState, qs: jax.Array) -> jax.Array:
     qs = jnp.atleast_1d(jnp.asarray(qs, spec.dtype))
     if qs.shape[0] == 0:  # empty quantile list: [N, 0], nothing to select
         return jnp.zeros((state.n_streams, 0), spec.dtype)
-    neg_count = state.bins_neg.sum(-1)  # [N]
+    # ``neg_total`` is the ONE definition of the negative-store mass shared
+    # with the windowed/tiled kernels (ADVICE r3: recomputing
+    # ``bins_neg.sum(-1)`` here accumulated in a different order, so rank
+    # thresholds near exact boundaries could differ by one bucket between
+    # engines).  It also saves the bin pre-scan the counter exists to avoid.
+    neg_count = state.neg_total  # [N]
     count = state.count
     rank = qs[None, :] * (count[:, None] - 1)  # [N, Q]
 
@@ -920,8 +925,9 @@ class BatchedDDSketch:
                 bin_dtype=bin_dtype,
             )
         self.spec = spec
-        self.state = init(spec, n_streams) if state is None else state
+        self._state = init(spec, n_streams) if state is None else state
         self._auto_recenter_pending = bool(auto_recenter) and state is None
+        self._policy_stale = False
         from sketches_tpu import kernels
 
         use_pallas, interpret = kernels.select_engine(spec, n_streams, engine)
@@ -962,8 +968,10 @@ class BatchedDDSketch:
         )
         self._merge_body = functools.partial(_merge_aligned_body, spec)
         # Derive-offsets-from-this-batch, recenter masked streams, ingest --
-        # one dispatch.  Used for the first batch (mask = all streams) and
-        # for maybe_recenter's armed follow-up (mask = drifting streams).
+        # one dispatch.  Used for the first batch (mask = still-empty
+        # streams) and for maybe_recenter's armed follow-up (mask = drifting
+        # streams, mass and all -- drift chasing moves occupied windows on
+        # purpose).
         def _recenter_add(st, values, weights, mask):
             offs = auto_offset(spec, st, values, weights)
             st = recenter(spec, st, jnp.where(mask, offs, st.key_offset))
@@ -1006,7 +1014,18 @@ class BatchedDDSketch:
             # take the fast paths.
             armed_by_policy = self._pending_recenter_mask is not None
             if self._auto_recenter_pending:
-                mask = jnp.ones((self.n_streams,), bool)
+                # First-batch auto-center applies only to streams with no
+                # binned mass: a populated state assigned after construction
+                # (checkpoint restore via ``sk.state = ...``) must keep its
+                # windows -- recentering it onto this batch's medians would
+                # silently collapse the restored mass (review r4).  On a
+                # truly fresh facade this is the all-ones mask it always was.
+                st = self.state
+                mask = (st.count - st.zero_count) <= 0
+                if armed_by_policy:
+                    mask = jnp.logical_or(
+                        mask, jnp.asarray(self._pending_recenter_mask)
+                    )
             else:
                 mask = jnp.asarray(self._pending_recenter_mask)
             self._auto_recenter_pending = False
@@ -1140,7 +1159,11 @@ class BatchedDDSketch:
             if fn is None:
                 fn = jax.jit(body, donate_argnums=(0,))
                 self._op_jits[key] = fn
-            self.state = fn(self.state, *args)
+            # Internal mutators assign _state directly (callers clear the
+            # window plan themselves); the ``state`` setter is the external
+            # choke point and also arms the policy re-baseline, which must
+            # NOT fire on ordinary ingest.
+            self._state = fn(self.state, *args)
             return
         n = self.n_streams
 
@@ -1177,18 +1200,18 @@ class BatchedDDSketch:
             if fn_rem is None:
                 fn_rem = self._op_jits[(key, rem)] = make(rem)
             st = fn_rem(st, k * chunk, *args)
-        self.state = st
+        self._state = st
 
     # -- adaptive window ---------------------------------------------------
     def recenter(self, new_key_offset) -> "BatchedDDSketch":
         """Slide the window(s) to ``new_key_offset`` (scalar or [n_streams])."""
-        self.state = self._recenter(self.state, jnp.asarray(new_key_offset))
+        self._state = self._recenter(self.state, jnp.asarray(new_key_offset))
         self._window_plan = None
         return self
 
     def recenter_to_data(self) -> "BatchedDDSketch":
         """Recenter each stream's window on its binned-mass median key."""
-        self.state = self._recenter_to_data(self.state)
+        self._state = self._recenter_to_data(self.state)
         self._window_plan = None
         return self
 
@@ -1240,6 +1263,13 @@ class BatchedDDSketch:
         d_binned = binned - self._policy_binned
         self._policy_collapsed = collapsed
         self._policy_binned = binned
+        if self._policy_stale:
+            # The state was assigned wholesale since the last baseline
+            # (external ``sk.state = ...``): the deltas above compare
+            # against a different state's history.  Re-baseline (just done)
+            # and start measuring drift from here.
+            self._policy_stale = False
+            return False
         mask = d_coll > threshold * np.maximum(d_binned, 1.0)
         if mask.any():
             prev = self._pending_recenter_mask
@@ -1253,6 +1283,29 @@ class BatchedDDSketch:
         return self.spec == other.spec
 
     # -- accessors ---------------------------------------------------------
+    @property
+    def state(self) -> SketchState:
+        return self._state
+
+    @state.setter
+    def state(self, new_state: SketchState) -> None:
+        # ``state`` is deliberately assignable (checkpoint restore, tests,
+        # power users) -- the setter is the EXTERNAL choke point that keeps
+        # every cache describing the old state honest (internal mutators
+        # assign ``_state`` directly and manage their own caches):
+        # * the window plan (a stale plan makes the windowed query silently
+        #   truncate quantile mass -- ADVICE r3);
+        # * the maybe_recenter delta baselines (stale snapshots would
+        #   misread the new state's pre-existing collapse as fresh drift and
+        #   fire a spurious recenter -- review r4); the next maybe_recenter
+        #   call re-baselines instead of comparing.
+        # A pending first-batch auto-center needs no flag handling here: its
+        # mask excludes streams that already hold binned mass, so an
+        # assigned populated state keeps its windows.
+        self._state = new_state
+        self._window_plan = None
+        self._policy_stale = True
+
     @property
     def n_streams(self) -> int:
         return self.state.n_streams
@@ -1295,6 +1348,7 @@ class BatchedDDSketch:
         )
         new._policy_collapsed = self._policy_collapsed.copy()
         new._policy_binned = self._policy_binned.copy()
+        new._policy_stale = self._policy_stale
         return new
 
     def __repr__(self) -> str:

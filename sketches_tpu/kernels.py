@@ -852,7 +852,34 @@ def fused_quantile_windowed(
             f"n_streams={n} must be a multiple of the stream block"
             f" ({bn}); pad the batch or pass block_streams"
         )
-    lo_tile = jnp.reshape(jnp.asarray(lo_wblock, jnp.int32), (1,))
+    # Static window-plan validity (ADVICE r3): a caller-supplied plan whose
+    # blocks are misaligned or overrun the bin array would make the BlockSpec
+    # index map point past the arrays, which TPU Pallas silently clamps to
+    # the last block (duplicated reads, wrong counts) instead of raising.
+    # The dynamic part (lo_wblock) is checked at the same trace-time bound:
+    # the in-repo plan producers always satisfy lo + n <= tiles, and a
+    # traced lo cannot be validated without a host sync, so the static
+    # guards bound the exposure to a window that at worst re-reads the last
+    # in-range block.
+    if w_tiles not in (1, 2, 4) or spec.n_bins % (w_tiles * LO) != 0:
+        raise ValueError(
+            f"w_tiles={w_tiles} must divide the {spec.n_bins}-bin array"
+            " into whole column blocks (and be one of 1/2/4)"
+        )
+    if not 1 <= n_wblocks <= spec.n_bins // (w_tiles * LO):
+        raise ValueError(
+            f"n_wblocks={n_wblocks} window ({n_wblocks * w_tiles * LO} bins)"
+            f" exceeds the {spec.n_bins}-bin array"
+        )
+    # The dynamic window start clamps into range ONCE, before both the
+    # index map and the kernel's decode read it (ADVICE r3): an out-of-range
+    # traced lo_wblock then reads a self-consistent in-range window (wrong
+    # answer caught by parity tests) instead of Pallas's silent per-block
+    # clamping leaving the decode offset pointing at blocks never read.
+    max_lo = spec.n_bins // (w_tiles * LO) - n_wblocks
+    lo_tile = jnp.clip(
+        jnp.reshape(jnp.asarray(lo_wblock, jnp.int32), (1,)), 0, max_lo
+    )
 
     # Pre-packed per-stream thresholds (one XLA pass over [N] vectors --
     # negligible next to the bins read): pos_rank | rev_rank + 1 | key lo.

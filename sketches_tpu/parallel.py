@@ -365,13 +365,13 @@ class DistributedDDSketch:
                 " pad with weights=0 entries"
             )
         if weights is None:
-            self.partials = self._ingest_unweighted(self.partials, values)
+            self._partials = self._ingest_unweighted(self.partials, values)
         else:
             weights = jnp.asarray(weights, self.spec.dtype)
             if weights.ndim == 1:  # per-stream weights (batched-facade parity)
                 weights = weights[:, None]
             weights = jnp.broadcast_to(weights, values.shape)
-            self.partials = self._ingest(self.partials, values, weights)
+            self._partials = self._ingest(self.partials, values, weights)
         self._merged_cache = None
         self._window_plan = None
         return self
@@ -438,7 +438,7 @@ class DistributedDDSketch:
             raise UnequalSketchParametersError(
                 "Cannot merge distributed sketches with different specs"
             )
-        self.partials = self._merge_partials(self.partials, other.partials)
+        self._partials = self._merge_partials(self.partials, other.partials)
         self._merged_cache = None
         self._window_plan = None
         return self
@@ -459,6 +459,19 @@ class DistributedDDSketch:
         )
 
     # -- accessors ---------------------------------------------------------
+    @property
+    def partials(self) -> SketchState:
+        return self._partials
+
+    @partials.setter
+    def partials(self, new_partials: SketchState) -> None:
+        # Same staleness choke point as ``BatchedDDSketch.state`` (ADVICE
+        # r3): ``partials`` is public, and a direct assignment must drop the
+        # cached fold and window plan or queries describe the old state.
+        self._partials = new_partials
+        self._merged_cache = None
+        self._window_plan = None
+
     @property
     def count(self) -> jax.Array:
         return self.merged_state().count

@@ -615,3 +615,44 @@ def test_chunked_facade_pallas_engine_parity(monkeypatch):
             np.asarray(getattr(ref.state, f)),
             f,
         )
+
+
+def test_state_assignment_keeps_restored_windows():
+    """Assigning a populated state into a fresh facade (checkpoint-restore
+    idiom) must survive the still-pending first-batch auto-center: the
+    auto-center mask excludes streams that already hold binned mass, so the
+    restored windows stay put (review r4)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(11)
+    data = (rng.lognormal(0, 1.5, (64, 2048)) * 1e-6).astype(np.float32)
+    src = BatchedDDSketch(64)
+    src.add(data)
+    dst = BatchedDDSketch(64)  # auto-center pending
+    dst.state = jax.tree.map(jnp.copy, src.state)
+    tail = np.ones((64, 8), np.float32)
+    dst.add(tail)  # pre-fix: recentered ALL streams onto key(1.0)
+    exact = np.quantile(np.concatenate([data, tail], 1), 0.5, axis=1,
+                        method="lower")
+    got = np.asarray(dst.get_quantile_values([0.5]))[:, 0]
+    assert np.all(np.abs(got - exact) <= 0.0101 * np.abs(exact) + 1e-12)
+
+
+def test_state_assignment_rebaselines_policy():
+    """maybe_recenter must not misread an assigned state's pre-existing
+    collapse as fresh drift: the first call after ``sk.state = ...``
+    re-baselines and reports False; genuine drift past that point still
+    arms (review r4)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(12)
+    data = rng.lognormal(0, 2.5, (64, 4096)).astype(np.float32)
+    m = BatchedDDSketch(64, n_bins=256, key_offset=-128)
+    m.add(data)  # tight window: plenty of collapse on record
+    f = BatchedDDSketch(64, n_bins=256, key_offset=-128)
+    f.state = jax.tree.map(jnp.copy, m.state)
+    assert f.maybe_recenter() is False
+    f.add((data * 1e12).astype(np.float32))  # regime shift: real collapse
+    assert f.maybe_recenter() is True
