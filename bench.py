@@ -648,6 +648,7 @@ def verify_on_device():
                 "bins_pos", "bins_neg", "zero_count", "count", "sum",
                 "min", "max", "collapsed_low", "collapsed_high",
                 "pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total",
+                "tile_sums",
             ):
                 a, b = np.asarray(getattr(got, f)), np.asarray(getattr(ref, f))
                 if not np.allclose(a, b, rtol=1e-5, atol=1e-4, equal_nan=True):

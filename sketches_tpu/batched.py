@@ -75,6 +75,11 @@ __all__ = [
 DEFAULT_REL_ACC = 0.01
 DEFAULT_N_BINS = 2048
 
+# Column-tile width of the bin axis: the TPU lane width, and the granule of
+# the per-tile mass summaries (``SketchState.tile_sums``) every query tier
+# uses for hierarchical rank selection.  Must match ``kernels.LO``.
+TILE = 128
+
 
 @dataclasses.dataclass(frozen=True)
 class SketchSpec:
@@ -130,6 +135,12 @@ class SketchSpec:
     def bins_integer(self) -> bool:
         """Whether the bins/counters accumulate in an integer dtype."""
         return jnp.issubdtype(jnp.dtype(self.bin_dtype), jnp.integer)
+
+    @property
+    def n_tiles(self) -> int:
+        """Column tiles per store: ``ceil(n_bins / 128)`` (ragged last tile
+        for non-128-multiple bin counts)."""
+        return -(-self.n_bins // TILE)
 
     @functools.cached_property
     def mapping(self) -> KeyMapping:
@@ -208,6 +219,21 @@ class SketchState:
     # total *before* any bin is read) are available to single-pass windowed
     # query kernels without a pre-scan of ``bins_neg``.
     neg_total: jax.Array  # [n_streams]
+    # Per-tile mass summaries: ``tile_sums[:, t]`` is the total mass of
+    # ``bins_pos[:, t*128:(t+1)*128]`` for ``t < n_tiles``, and of the
+    # matching ``bins_neg`` tile for ``t >= n_tiles`` -- one [N, 2*T] array
+    # (both stores share one 128-lane HBM stripe).  Maintained incrementally
+    # by every ingest engine (VERDICT r3 item 1: nearly free next to the
+    # histogram build) so a query can do *hierarchical rank selection*:
+    # locate each (stream, q)'s crossing tile from the summaries alone and
+    # read only that 128-bin tile of the store -- worst-case query HBM
+    # bytes become occupancy-independent.  In float mode the per-call delta
+    # accumulation can differ from ``bins.reshape(...).sum(-1)`` by ULPs
+    # (different summation order; exact for unit-weight/integer masses) --
+    # consumers treat a summary-derived crossing as at-most-one-bucket
+    # approximate, the same contract as the engines' shared one-ULP rank
+    # divergence (ADVICE r3).
+    tile_sums: jax.Array  # [n_streams, 2 * n_tiles]
 
     # Combined-store window bounds (derived): what a windowed query plans
     # its HBM read against.
@@ -250,7 +276,41 @@ def init(spec: SketchSpec, n_streams: int) -> SketchState:
         neg_lo=jnp.full((n_streams,), spec.n_bins, dtype=jnp.int32),
         neg_hi=jnp.full((n_streams,), -1, dtype=jnp.int32),
         neg_total=jnp.zeros_like(zeros1),
+        tile_sums=jnp.zeros((n_streams, 2 * spec.n_tiles), dtype=bd),
     )
+
+
+def tile_sums_of(bins_pos: jax.Array, bins_neg: jax.Array) -> jax.Array:
+    """Recompute the [N, 2*T] per-tile masses from the bins (device).
+
+    The from-scratch twin of the incremental maintenance in the ingest
+    engines -- used where the bins are being streamed anyway (recenter,
+    checkpoint backfill).  Ragged bin counts zero-pad the last tile.
+    """
+    n, b = bins_pos.shape
+    t = -(-b // TILE)
+    pad = t * TILE - b
+
+    def tiles(x):
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad)))
+        return x.reshape(n, t, TILE).sum(-1)
+
+    return jnp.concatenate([tiles(bins_pos), tiles(bins_neg)], axis=1)
+
+
+def tile_sums_np(bins_pos: np.ndarray, bins_neg: np.ndarray) -> np.ndarray:
+    """Host (numpy) twin of :func:`tile_sums_of` for interop/restore paths."""
+    n, b = bins_pos.shape
+    t = -(-b // TILE)
+    pad = t * TILE - b
+
+    def tiles(x):
+        if pad:
+            x = np.pad(x, ((0, 0), (0, pad)))
+        return x.reshape(n, t, TILE).sum(-1)
+
+    return np.concatenate([tiles(bins_pos), tiles(bins_neg)], axis=1)
 
 
 def _occupied_bounds(bins: jax.Array):
@@ -368,6 +428,11 @@ def add(
     zero_b = jnp.asarray(0, bd)
     hits_pos = jnp.logical_and(live, is_pos)
     hits_neg = jnp.logical_and(live, is_neg)
+    # Tile-summary maintenance: one extra (tiny) scatter into [N, 2*T] --
+    # the same per-lane mass, keyed by the bin's column tile, with negative
+    # hits offset into the upper T columns.  Dead/zero lanes carry zero
+    # mass, so their (dummy) target tile is harmless.
+    tile_tgt = idx // TILE + jnp.where(is_neg, jnp.int32(spec.n_tiles), 0)
     return SketchState(
         bins_pos=scatter(state.bins_pos, idx, wb_pos),
         bins_neg=scatter(state.bins_neg, idx, wb_neg),
@@ -414,6 +479,7 @@ def add(
             ),
         ),
         neg_total=state.neg_total + wb_neg.sum(-1),
+        tile_sums=scatter(state.tile_sums, tile_tgt, signed),
     )
 
 
@@ -563,6 +629,7 @@ def merge(spec: SketchSpec, a: SketchState, b: SketchState) -> SketchState:
         neg_lo=jnp.minimum(a.neg_lo, b.neg_lo),
         neg_hi=jnp.maximum(a.neg_hi, b.neg_hi),
         neg_total=a.neg_total + b.neg_total,
+        tile_sums=a.tile_sums + b.tile_sums,
     )
 
 
@@ -593,6 +660,7 @@ def merge_axis(spec: SketchSpec, state: SketchState, axis: int = 0) -> SketchSta
         neg_lo=state.neg_lo.min(axis),
         neg_hi=state.neg_hi.max(axis),
         neg_total=state.neg_total.sum(axis),
+        tile_sums=state.tile_sums.sum(axis),
     )
 
 
@@ -764,6 +832,10 @@ def _recenter_body(
         neg_lo=neg_lo,
         neg_hi=neg_hi,
         neg_total=state.neg_total,
+        # The roll streams every bin anyway: recompute the summaries
+        # exactly from the rolled bins (also resets any accumulated ULP
+        # drift between summaries and bins in float mode).
+        tile_sums=tile_sums_of(new_pos, new_neg),
     )
 
 
@@ -857,6 +929,26 @@ def auto_offset(
     return jnp.where(n_live > 0, centered, state.key_offset).astype(jnp.int32)
 
 
+def data_center_offsets(spec: SketchSpec, state: SketchState) -> jax.Array:
+    """Window offsets centering each stream on its binned-mass median key.
+
+    The derivation half of :func:`recenter_to_data`, exposed so the
+    distributed tier can compute targets from a FOLDED state and broadcast
+    one recenter to every partial.  Streams with no binned mass keep their
+    offset.
+    """
+    mass = state.bins_pos + state.bins_neg  # [N, B]
+    total = mass.sum(-1)
+    cum = jnp.cumsum(mass, axis=-1)
+    # Smallest index with cum >= total/2 = #(cum < total/2).
+    center = (cum < total[:, None] * 0.5).sum(-1).astype(jnp.int32)
+    return jnp.where(
+        total > 0,
+        state.key_offset + center - jnp.int32(_center_bin(spec)),
+        state.key_offset,
+    )
+
+
 def recenter_to_data(spec: SketchSpec, state: SketchState) -> SketchState:
     """Recenter each stream's window on its binned-mass median key.
 
@@ -868,19 +960,9 @@ def recenter_to_data(spec: SketchSpec, state: SketchState) -> SketchState:
     span's midpoint) makes the policy converge when recent data piles up at
     one edge: the median chases the pile, and a following
     :func:`maybe_recenter <BatchedDDSketch.maybe_recenter>` round brings the
-    window fully onto it.  Streams with no binned mass keep their offset.
+    window fully onto it.
     """
-    mass = state.bins_pos + state.bins_neg  # [N, B]
-    total = mass.sum(-1)
-    cum = jnp.cumsum(mass, axis=-1)
-    # Smallest index with cum >= total/2 = #(cum < total/2).
-    center = (cum < total[:, None] * 0.5).sum(-1).astype(jnp.int32)
-    new_off = jnp.where(
-        total > 0,
-        state.key_offset + center - jnp.int32(_center_bin(spec)),
-        state.key_offset,
-    )
-    return recenter(spec, state, new_off)
+    return recenter(spec, state, data_center_offsets(spec, state))
 
 
 # ---------------------------------------------------------------------------
@@ -944,25 +1026,28 @@ class BatchedDDSketch:
         else:
             self._add_pallas = None
             self._batch_ok = lambda s: False
-        if use_pallas and not spec.bins_integer:
-            self._quantile = jax.jit(
-                functools.partial(kernels.fused_quantile, spec, interpret=interpret)
-            )
-            # Windowed query: reads only the occupied bin span (plus the
-            # negative store only when it holds mass).  The plan -- window
-            # position/width and store participation -- comes from one tiny
-            # host fetch of the state's bound counters, cached until the
-            # next ingest/merge/recenter mutates the state.
-            self._windowed_jits = {}
-            self._window_plan = None
-            self._interpret = interpret
-        else:
-            # Integer-bin specs always query via the XLA path: its integer
-            # cumsum + rank compare is exact past 2**24 where the kernel's
-            # bf16-term scan is not (see kernels.fused_quantile).
-            self._quantile = jax.jit(functools.partial(quantile, spec))
-            self._windowed_jits = None
-            self._window_plan = None
+        # Query engines, fastest-eligible first (see _query_fn):
+        # * tile-list Pallas kernel -- hierarchical rank selection off the
+        #   state's tile summaries; HBM bytes scale with the number of
+        #   distinct crossing tiles (float bins, TPU, small Q);
+        # * windowed Pallas kernel -- walks the occupied span (float bins,
+        #   TPU, wide Q);
+        # * windowed XLA -- occupied-span slice of the portable rank walk
+        #   (any engine; THE path for integer bins, whose compare runs in
+        #   integer space, exact past 2**24);
+        # * full XLA quantile -- ragged n_bins fallback.
+        # Plans (window position, store participation, tile-list width)
+        # each cost one tiny host fetch after a state mutation and are
+        # cached until the next ingest/merge/recenter.
+        self._pallas_query = use_pallas and not spec.bins_integer
+        self._interpret = interpret
+        self._windowed_jits = {}
+        self._tiles_jits = {}
+        self._wxla_jits = {}
+        self._window_plan = None
+        self._tile_plans = {}
+        self._wxla_ok = spec.n_bins % 128 == 0
+        self._quantile = jax.jit(functools.partial(quantile, spec))
         self._merge = jax.jit(
             functools.partial(merge, spec), donate_argnums=(0,)
         )
@@ -1056,7 +1141,7 @@ class BatchedDDSketch:
             self._stream_op("add_pallas", self._add_pallas, values, weights)
         else:
             self._stream_op("add_xla", self._add_xla, values, weights)
-        self._window_plan = None
+        self._invalidate_plans()
         return self
 
     def add_validated(self, values, weights=None) -> "BatchedDDSketch":
@@ -1068,50 +1153,117 @@ class BatchedDDSketch:
             raise ValueError("weights must be non-negative (0 = padding)")
         return self.add(values, weights)
 
-    def _query_fn(self, q_total: int):
-        """The query dispatch: windowed Pallas kernel when eligible.
+    def _invalidate_plans(self) -> None:
+        self._window_plan = None
+        self._tile_plans = {}
 
-        The window plan costs one small host fetch (three scalars folded
-        from the [N] bound counters) the first query after a state
-        mutation; repeat queries reuse it.  Jits cache per
-        ``(n_wblocks, w_tiles, with_neg, q_total)`` -- a window that merely
-        *slides* recompiles nothing (the position is a traced scalar).
+    def _query_fn(self, qs_tuple: tuple):
+        """The query dispatch (see the engine ladder in ``__init__``).
+
+        Each plan costs one small host fetch the first query after a state
+        mutation; repeat queries reuse it.  Jits cache per static plan
+        shape -- a window/tile-list that merely *slides* recompiles
+        nothing (positions are traced).
         """
-        if self._windowed_jits is None:
-            return self._quantile
         from sketches_tpu import kernels
 
-        if self._window_plan is None:
-            self._window_plan = kernels.plan_state_window(
-                self.spec, self.state
-            )
-        lo_w, n_w, w_t, with_neg = self._window_plan
-        key = (n_w, w_t, with_neg, q_total)
-        fn = self._windowed_jits.get(key)
-        if fn is None:
-            fn = jax.jit(
-                functools.partial(
-                    kernels.fused_quantile_windowed,
-                    self.spec,
-                    n_wblocks=n_w,
-                    w_tiles=w_t,
-                    with_neg=with_neg,
-                    interpret=self._interpret,
+        q_total = len(qs_tuple)
+        if self._pallas_query:
+            if self._window_plan is None:
+                self._window_plan = kernels.plan_state_window(
+                    self.spec, self.state
                 )
+            lo_w, n_w, w_t, with_neg = self._window_plan
+            # Engine choice within Pallas (both measured at the 131k x 512
+            # shard shape): a single-tile occupied window is the windowed
+            # kernel's best case (one wide DMA, no list machinery).  For
+            # wider spans, the tile-list kernel wins when its per-block
+            # needed-tile bound beats the window span (bytes) or when the
+            # negative store participates (the windowed kernel then scans
+            # BOTH spans; the tile fold's per-tile compute is far cheaper).
+            span = n_w * w_t
+            if (
+                q_total <= 8
+                and 2 <= self.spec.n_tiles <= 31  # int32 bitmask bound
+                and span > 1
+            ):
+                # Tile-list plan (list width + store participation)
+                # depends on the requested quantiles: cached per qs tuple.
+                plan = self._tile_plans.get(qs_tuple)
+                if plan is None:
+                    plan = kernels.plan_tile_query(
+                        self.spec, self.state, jnp.asarray(qs_tuple)
+                    )
+                    self._tile_plans[qs_tuple] = plan
+                k_tiles, with_neg_t = plan
+                k_eff = k_tiles * (2 if with_neg_t else 1)
+                win_eff = span * (2 if with_neg else 1)
+                if with_neg_t or k_eff < win_eff:
+                    key = (k_tiles, with_neg_t, q_total)
+                    fn = self._tiles_jits.get(key)
+                    if fn is None:
+                        fn = jax.jit(
+                            functools.partial(
+                                kernels.fused_quantile_tiles,
+                                self.spec,
+                                k_tiles=k_tiles,
+                                with_neg=with_neg_t,
+                                interpret=self._interpret,
+                            )
+                        )
+                        self._tiles_jits[key] = fn
+                    return fn
+            key = (n_w, w_t, with_neg, q_total)
+            fn = self._windowed_jits.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(
+                        kernels.fused_quantile_windowed,
+                        self.spec,
+                        n_wblocks=n_w,
+                        w_tiles=w_t,
+                        with_neg=with_neg,
+                        interpret=self._interpret,
+                    )
+                )
+                self._windowed_jits[key] = fn
+            return functools.partial(
+                lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w
             )
-            self._windowed_jits[key] = fn
-        return functools.partial(
-            lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w
-        )
+        if self._wxla_ok:
+            if self._window_plan is None:
+                self._window_plan = kernels.plan_state_window(
+                    self.spec, self.state
+                )
+            lo_w, n_w, w_t, with_neg = self._window_plan
+            tiles_window = n_w * w_t
+            key = (tiles_window, with_neg, q_total)
+            fn = self._wxla_jits.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    functools.partial(
+                        kernels.quantile_windowed_xla,
+                        self.spec,
+                        n_tiles_window=tiles_window,
+                        with_neg=with_neg,
+                    )
+                )
+                self._wxla_jits[key] = fn
+            return functools.partial(
+                lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w * w_t
+            )
+        return self._quantile
 
     def get_quantile_value(self, quantile: float) -> jax.Array:
         """Per-stream value at ``quantile`` -> ``[n_streams]`` (NaN if empty)."""
-        return self._query_fn(1)(self.state, jnp.asarray([quantile]))[:, 0]
+        return self._query_fn((float(quantile),))(
+            self.state, jnp.asarray([quantile])
+        )[:, 0]
 
     def get_quantile_values(self, quantiles: Sequence[float]) -> jax.Array:
         """Fused multi-quantile (e.g. p50/p90/p99/p999) -> ``[n_streams, Q]``."""
-        qs = list(quantiles)
-        return self._query_fn(len(qs))(self.state, jnp.asarray(qs))
+        qs = [float(q) for q in quantiles]
+        return self._query_fn(tuple(qs))(self.state, jnp.asarray(qs))
 
     def merge(self, other: "BatchedDDSketch") -> "BatchedDDSketch":
         """Fold ``other`` into self (consumes neither spec; checks mergeability).
@@ -1129,7 +1281,7 @@ class BatchedDDSketch:
                 "Cannot merge two batched sketches with different specs"
             )
         self._stream_op("merge_aligned", self._merge_body, other.state)
-        self._window_plan = None
+        self._invalidate_plans()
         # A merge that brings mass populates the batch: a still-pending
         # first-batch auto-center would recenter away from that mass.  An
         # empty operand (e.g. a reduce's identity element) leaves the
@@ -1206,13 +1358,13 @@ class BatchedDDSketch:
     def recenter(self, new_key_offset) -> "BatchedDDSketch":
         """Slide the window(s) to ``new_key_offset`` (scalar or [n_streams])."""
         self._state = self._recenter(self.state, jnp.asarray(new_key_offset))
-        self._window_plan = None
+        self._invalidate_plans()
         return self
 
     def recenter_to_data(self) -> "BatchedDDSketch":
         """Recenter each stream's window on its binned-mass median key."""
         self._state = self._recenter_to_data(self.state)
-        self._window_plan = None
+        self._invalidate_plans()
         return self
 
     def overflow_risk(self):
@@ -1301,10 +1453,13 @@ class BatchedDDSketch:
         #   call re-baselines instead of comparing.
         # A pending first-batch auto-center needs no flag handling here: its
         # mask excludes streams that already hold binned mass, so an
-        # assigned populated state keeps its windows.
+        # assigned populated state keeps its windows.  An ARMED drift mask,
+        # however, was derived from the old state's deltas and would
+        # recenter the new state's streams on the next add -- drop it.
         self._state = new_state
-        self._window_plan = None
+        self._invalidate_plans()
         self._policy_stale = True
+        self._pending_recenter_mask = None
 
     @property
     def n_streams(self) -> int:
@@ -1374,6 +1529,11 @@ def to_host_sketches(spec: SketchSpec, state: SketchState):
     device-only collapse counters ride along as ``_collapsed_low`` /
     ``_collapsed_high`` attributes so ``from_host_sketches`` can round-trip
     them.
+
+    Bulk path (VERDICT r4 item 6): stores are constructed directly from
+    numpy row slices of the occupied span -- the exact state organic
+    ``store.add`` growth would reach, without the per-stream per-bin
+    Python loop that made 1M-stream materialization take minutes.
     """
     from sketches_tpu.ddsketch import BaseDDSketch
     from sketches_tpu.store import CollapsingLowestDenseStore
@@ -1384,20 +1544,42 @@ def to_host_sketches(spec: SketchSpec, state: SketchState):
          state.collapsed_high, state.key_offset)
     )
     (bins_pos, bins_neg, zero_count, count, total, vmin, vmax,
-     clow, chigh, koff) = host
+     clow, chigh, koff) = (np.asarray(a) for a in host)
+    bins_pos = bins_pos.astype(np.float64)
+    bins_neg = bins_neg.astype(np.float64)
+    plo, phi = occupied_bounds_np(bins_pos)
+    nlo, nhi = occupied_bounds_np(bins_neg)
+    # Per-store masses once, vectorized (counters may disagree with the
+    # bins by design only in f32 rounding; stores carry the bins' truth).
+    pos_count = bins_pos.sum(axis=-1)
+    neg_count = bins_neg.sum(axis=-1)
+    mapping = mapping_from_name(spec.mapping_name, spec.relative_accuracy)
+
+    def load_store(store, row, lo, hi, mass, off):
+        if hi < 0:  # empty store
+            return
+        lo_k, hi_k = int(lo + off), int(hi + off)
+        length = store._get_new_length(lo_k, hi_k)
+        seg = np.zeros(length, np.float64)
+        seg[: hi - lo + 1] = row[lo : hi + 1]
+        store.bins = seg.tolist()
+        store.offset = lo_k
+        store.min_key = lo_k
+        store.max_key = hi_k
+        store.count = float(mass)
+
     sketches = []
     for i in range(state.n_streams):
         sk = BaseDDSketch(
-            mapping=mapping_from_name(spec.mapping_name, spec.relative_accuracy),
+            mapping=mapping,
             store=CollapsingLowestDenseStore(spec.n_bins),
             negative_store=CollapsingLowestDenseStore(spec.n_bins),
         )
-        for bins, store in (
-            (bins_pos[i], sk.store),
-            (bins_neg[i], sk.negative_store),
-        ):
-            for j in np.nonzero(bins)[0]:
-                store.add(int(j) + int(koff[i]), float(bins[j]))
+        off = int(koff[i])
+        load_store(sk.store, bins_pos[i], plo[i], phi[i], pos_count[i], off)
+        load_store(
+            sk.negative_store, bins_neg[i], nlo[i], nhi[i], neg_count[i], off
+        )
         sk._zero_count = float(zero_count[i])
         sk._count = float(count[i])
         sk._sum = float(total[i])
@@ -1440,17 +1622,23 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
                 f" spec mapping {spec.mapping!r}"
             )
         for arr, store in ((bins_pos, sk.store), (bins_neg, sk.negative_store)):
-            for key in store.keys():
-                w = store.bins[key - store.offset]
-                j = key - spec.key_offset
-                if j < 0:
-                    arr[i, 0] += w
-                    clow[i] += w
-                elif j >= spec.n_bins:
-                    arr[i, -1] += w
-                    chigh[i] += w
-                else:
-                    arr[i, j] += w
+            # Whole-store numpy placement (VERDICT r4 item 6): the store's
+            # dense run lands as one slice, with out-of-window mass folded
+            # into the edge bins (clamped-ingest semantics).
+            row = np.asarray(store.bins, np.float64)
+            if row.size == 0:
+                continue
+            j = np.arange(row.size) + (store.offset - spec.key_offset)
+            low = j < 0
+            high = j >= spec.n_bins
+            mid = ~(low | high)
+            low_mass = float(row[low].sum())
+            high_mass = float(row[high].sum())
+            arr[i, 0] += low_mass
+            clow[i] += low_mass
+            arr[i, -1] += high_mass
+            chigh[i] += high_mass
+            arr[i, j[mid]] += row[mid]  # consecutive (unique) indices
         zero[i] = sk.zero_count
         count[i] = sk.count
         total[i] = sk.sum
@@ -1486,4 +1674,5 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
         neg_lo=jnp.asarray(neg_lo),
         neg_hi=jnp.asarray(neg_hi),
         neg_total=cast(bins_neg.sum(axis=-1)),
+        tile_sums=cast(tile_sums_np(bins_pos, bins_neg)),
     )

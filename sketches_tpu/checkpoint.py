@@ -72,21 +72,29 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             arrays["key_offset"] = jnp.full(
                 arrays["count"].shape, spec.key_offset, dtype=jnp.int32
             )
-        # Pre-occupied-bounds checkpoints: derive per-store bounds and the
-        # negative total from the bins (host-side, one pass; exact).
-        if "pos_lo" not in arrays:
-            from sketches_tpu.batched import occupied_bounds_np
-
+        # Pre-occupied-bounds / pre-tile-summary checkpoints: derive the
+        # missing arrays from the bins (host-side, one pass; exact).
+        bp = bn = None
+        if "pos_lo" not in arrays or "tile_sums" not in arrays:
             # Materialize each compressed array once (npz re-decompresses
             # on every access).
             bp = np.asarray(data["bins_pos"])
             bn = np.asarray(data["bins_neg"])
+        if "pos_lo" not in arrays:
+            from sketches_tpu.batched import occupied_bounds_np
+
             for name, bins in (("pos", bp), ("neg", bn)):
                 lo, hi = occupied_bounds_np(bins)
                 arrays[f"{name}_lo"] = jnp.asarray(lo)
                 arrays[f"{name}_hi"] = jnp.asarray(hi)
             arrays["neg_total"] = jnp.asarray(
                 bn.sum(axis=-1).astype(bn.dtype)
+            )
+        if "tile_sums" not in arrays:  # r <= 3 checkpoints
+            from sketches_tpu.batched import tile_sums_np
+
+            arrays["tile_sums"] = jnp.asarray(
+                tile_sums_np(bp, bn).astype(bp.dtype)
             )
         state = SketchState(**arrays)
     return spec, state

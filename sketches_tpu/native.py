@@ -264,7 +264,7 @@ class NativeDDSketch:
         pos, neg = self.bins()
         c = self._counters()
         as_row = lambda x: jnp.asarray(x, jnp.float32)[None]
-        from sketches_tpu.batched import occupied_bounds_np
+        from sketches_tpu.batched import occupied_bounds_np, tile_sums_np
 
         (pos_lo, pos_hi), (neg_lo, neg_hi) = (
             occupied_bounds_np(pos), occupied_bounds_np(neg)
@@ -285,6 +285,9 @@ class NativeDDSketch:
             neg_lo=jnp.asarray([neg_lo], jnp.int32),
             neg_hi=jnp.asarray([neg_hi], jnp.int32),
             neg_total=jnp.asarray([neg.sum()], jnp.float32),
+            tile_sums=jnp.asarray(
+                tile_sums_np(pos[None], neg[None]), jnp.float32
+            ),
         )
 
     @classmethod

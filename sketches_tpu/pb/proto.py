@@ -63,13 +63,46 @@ class KeyMappingProto:
         )
 
     @classmethod
-    def from_proto(cls, proto: pb.IndexMapping) -> KeyMapping:
+    def from_proto(
+        cls, proto: pb.IndexMapping, *, assume_native_linear: bool = False
+    ) -> KeyMapping:
+        """Decode an IndexMapping.
+
+        NONE (exact logarithmic) and CUBIC decode unconditionally: their
+        key functions are mathematically forced by the (gamma,
+        interpolation) pair -- ``ceil(log_gamma v)`` and the A/B/C cubic
+        with the 7/10 multiplier correction -- so same-enum emitters agree
+        on bucket boundaries.
+
+        LINEAR **raises by default**: this implementation's linear mapping
+        keeps the base 1/ln(gamma) multiplier UNSCALED (alpha-safe -- see
+        ``mapping.LinearlyInterpolatedMapping``), and whether upstream
+        family emitters share that convention could not be verified against
+        a reference tree (SURVEY.md provenance warning).  Decoding foreign
+        LINEAR bins with a mismatched key function would silently return
+        wrong quantiles -- a loud error is the only safe default.  Pass
+        ``assume_native_linear=True`` to decode bytes KNOWN to be produced
+        by this library's own LINEAR mapping (round-trips are tested).
+        """
         try:
             mapping_cls = _INTERPOLATION_TO_MAPPING[proto.interpolation]
         except KeyError:
             raise ValueError(
                 f"Unsupported interpolation {proto.interpolation}"
             ) from None
+        if (
+            mapping_cls is LinearlyInterpolatedMapping
+            and not assume_native_linear
+        ):
+            raise ValueError(
+                "Refusing to decode a LINEAR IndexMapping from foreign"
+                " bytes: the linear-interpolation key-multiplier convention"
+                " is implementation-defined and a mismatch silently"
+                " misdecodes every bin.  If these bytes were produced by"
+                " sketches_tpu itself, pass assume_native_linear=True."
+                " (LOG and CUBIC interop are convention-free and decode"
+                " unconditionally.)"
+            )
         # Invert gamma = (1 + alpha) / (1 - alpha).
         relative_accuracy = (proto.gamma - 1.0) / (proto.gamma + 1.0)
         return mapping_cls(relative_accuracy, offset=proto.indexOffset)
@@ -119,8 +152,12 @@ class DDSketchProto:
         )
 
     @classmethod
-    def from_proto(cls, proto: pb.DDSketch) -> DDSketch:
-        mapping = KeyMappingProto.from_proto(proto.mapping)
+    def from_proto(
+        cls, proto: pb.DDSketch, *, assume_native_linear: bool = False
+    ) -> DDSketch:
+        mapping = KeyMappingProto.from_proto(
+            proto.mapping, assume_native_linear=assume_native_linear
+        )
         sketch = DDSketch(mapping.relative_accuracy)
         sketch._mapping = mapping
         sketch._relative_accuracy = mapping.relative_accuracy
@@ -140,11 +177,19 @@ def batched_to_proto(spec, state) -> List[pb.DDSketch]:
     return [DDSketchProto.to_proto(sk) for sk in to_host_sketches(spec, state)]
 
 
-def batched_from_proto(spec, protos) -> "SketchState":  # noqa: F821
+def batched_from_proto(
+    spec, protos, *, assume_native_linear: bool = False
+) -> "SketchState":  # noqa: F821
     """Decode wire-format messages into one device batch (keys clamp into
     the spec window, mass conserved)."""
     from sketches_tpu.batched import from_host_sketches
 
     return from_host_sketches(
-        spec, [DDSketchProto.from_proto(p) for p in protos]
+        spec,
+        [
+            DDSketchProto.from_proto(
+                p, assume_native_linear=assume_native_linear
+            )
+            for p in protos
+        ],
     )

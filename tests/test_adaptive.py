@@ -545,6 +545,7 @@ def test_chunked_recenter_and_merge_parity(monkeypatch):
             "bins_pos", "bins_neg", "zero_count", "count", "sum", "min",
             "max", "collapsed_low", "collapsed_high", "key_offset",
             "pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total",
+            "tile_sums",
         ):
             np.testing.assert_array_equal(
                 np.asarray(getattr(got, f)), np.asarray(getattr(ref, f)), f

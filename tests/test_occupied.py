@@ -66,6 +66,20 @@ def assert_invariants(spec, state, *, weighted=False):
         np.testing.assert_allclose(neg, ref, rtol=1e-5, atol=1e-4)
     else:
         np.testing.assert_array_equal(neg, ref)
+    # Tile summaries match the bins tile-for-tile (exact for unit-weight
+    # masses; f32 rounding for arbitrary weights -- the documented
+    # at-most-one-bucket contract of summary-derived crossings).
+    from sketches_tpu.batched import tile_sums_np
+
+    got_tiles = np.asarray(state.tile_sums, np.float64)
+    ref_tiles = tile_sums_np(
+        np.asarray(state.bins_pos, np.float64),
+        np.asarray(state.bins_neg, np.float64),
+    )
+    if weighted:
+        np.testing.assert_allclose(got_tiles, ref_tiles, rtol=1e-5, atol=1e-3)
+    else:
+        np.testing.assert_array_equal(got_tiles, ref_tiles)
 
 
 def _values(n, s, seed=0):
@@ -86,6 +100,8 @@ def test_init_sentinels():
     assert (np.asarray(st.occ_lo) == 128).all()
     assert (np.asarray(st.occ_hi) == -1).all()
     assert (np.asarray(st.neg_total) == 0).all()
+    assert st.tile_sums.shape == (4, 2 * spec.n_tiles)
+    assert (np.asarray(st.tile_sums) == 0).all()
 
 
 @pytest.mark.parametrize("weighted", [False, True])
@@ -163,7 +179,10 @@ def test_checkpoint_backcompat_derives_bounds(tmp_path):
             k: data[k]
             for k in data.files
             if k
-            not in ("pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total")
+            not in (
+                "pos_lo", "pos_hi", "neg_lo", "neg_hi", "neg_total",
+                "tile_sums",
+            )
         }
     with open(path, "wb") as f:
         np.savez_compressed(f, **kept)
