@@ -168,9 +168,9 @@ def bench_native(n: int = 2_000_000):
 
 
 def _windowed_query_fn(spec, state, use_pallas):
-    """(query_fn, plan_dict) on the production path the facades take:
-    the windowed Pallas kernel with the plan derived from this state's
-    bound counters, or the XLA query where the kernels don't apply."""
+    """(query_fn, plan_dict) for the windowed Pallas kernel with the plan
+    derived from this state's bound counters, or the XLA query where the
+    kernels don't apply."""
     import functools as _ft
 
     from sketches_tpu import kernels
@@ -191,6 +191,95 @@ def _windowed_query_fn(spec, state, use_pallas):
         )
 
     return q_fn, plan
+
+
+def _tiles_query_fn(spec, state, qs):
+    """(query_fn, plan_dict) for the tile-list kernel (hierarchical rank
+    selection off the state's tile summaries), or (None, None) when the
+    spec is ineligible."""
+    from sketches_tpu import kernels
+
+    if spec.bins_integer or not (2 <= spec.n_tiles <= 31):
+        return None, None
+    k_tiles, with_neg = kernels.plan_tile_query(spec, state, qs)
+
+    def q_fn(st_, qs_):
+        return kernels.fused_quantile_tiles(
+            spec, st_, qs_, k_tiles=k_tiles, with_neg=with_neg
+        )
+
+    return q_fn, {"k_tiles": k_tiles, "with_neg": with_neg}
+
+
+def device_query_pcts(q_fn, state, qs, iters: int = 100):
+    """TRUE device-side p50/p99 of one query call, from profiler traces.
+
+    Dispatches ``iters`` independent (async) query calls under a
+    ``jax.profiler`` trace and reads each call's on-device duration out of
+    the perfetto event stream (the axon runtime exports the TPU device
+    track; verified against the fused-loop means).  This answers the
+    north-star's p99 with device-clocked per-call samples instead of
+    host-timed reps above the ~100 ms tunnel-sync floor (VERDICT r4
+    item 4).  Returns {p50_s, p99_s, n} or None when no device events
+    materialize (non-TPU backends).
+    """
+    import glob
+    import gzip
+    import json
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    def _q_traced(st_, qs_):
+        return q_fn(st_, qs_)
+
+    jq = jax.jit(_q_traced)
+    r = jq(state, qs)
+    _sync(r[:1, :1])  # compile + warm outside the trace
+    tmp = tempfile.mkdtemp(prefix="bench_trace_")
+    try:
+        with jax.profiler.trace(tmp, create_perfetto_trace=True):
+            outs = []
+            for i in range(iters):
+                # Perturb qs so no call is elided as a duplicate; results
+                # are kept (list) so none is dead.
+                outs.append(jq(state, qs * (1.0 - 1e-6 * i)))
+            _sync(outs[-1][:1, :1])
+        traces = sorted(glob.glob(f"{tmp}/**/perfetto_trace.json.gz",
+                                  recursive=True))
+        if not traces:
+            return None
+        with gzip.open(traces[-1]) as f:
+            data = json.load(f)
+        events = data if isinstance(data, list) else data.get("traceEvents", [])
+        device_pids = {
+            e["pid"] for e in events
+            if e.get("name") == "process_name"
+            and "TPU" in str(e.get("args", {}).get("name", ""))
+        }
+        durs = [
+            e["dur"] * 1e-6
+            for e in events
+            if e.get("ph") == "X" and e.get("pid") in device_pids
+            and str(e.get("name", "")).startswith("jit__q_traced")
+        ]
+        if len(durs) < iters // 2:
+            return None
+        # Report over ALL matched device events: every dispatch was warmed
+        # before the trace, and slicing either tail would bias the
+        # percentiles (review r4).
+        durs = np.asarray(durs)
+        return {
+            "p50_s": round(float(np.percentile(durs, 50)), 6),
+            "p99_s": round(float(np.percentile(durs, 99)), 6),
+            "n": int(durs.size),
+        }
+    except Exception:
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _device_bench(
@@ -263,6 +352,18 @@ def _device_bench(
     # (microsecond) dispatch cost on top.
     q_fn, plan = _windowed_query_fn(spec, state, use_pallas)
     qs = jnp.asarray(QS4, dtype=jnp.float32)
+    engine_pick = "windowed" if use_pallas else "xla"
+    if use_pallas and plan is not None:
+        q_tiles, plan_tiles = _tiles_query_fn(spec, state, qs)
+        if q_tiles is not None:
+            pick = kernels.choose_query_engine(
+                (plan["lo_wblock"], plan["n_wblocks"], plan["w_tiles"],
+                 plan["with_neg"]),
+                (plan_tiles["k_tiles"], plan_tiles["with_neg"]),
+            )
+            if pick == "tiles":
+                q_fn, plan = q_tiles, {**plan, **plan_tiles}
+                engine_pick = "tiles"
     q_iters = max(16, 2 * fused_k)
 
     def _q_body(i, acc, st_, qs_):
@@ -286,8 +387,9 @@ def _device_bench(
 
     collapsed = float(_sync(state.collapsed_low.sum() + state.collapsed_high.sum()))
     total = float(_sync(state.count.sum()))
-    return {
+    out = {
         "engine": "pallas" if use_pallas else "xla",
+        "query_engine": engine_pick,
         "ingest_dispatch_per_s": round(dispatch_per_s, 1),
         "ingest_fused_per_s": round(fused_per_s, 1),
         "query_p50_s": round(float(np.percentile(lat, 50)), 6),
@@ -295,6 +397,11 @@ def _device_bench(
         "query_window": plan,
         "collapsed_mass_frac": round(collapsed / max(total, 1.0), 6),
     }
+    if use_pallas:
+        pcts = device_query_pcts(q_fn, state, qs)
+        if pcts:
+            out["device_query"] = pcts
+    return out
 
 
 def bench_10k(profile: bool):
@@ -387,14 +494,25 @@ def bench_shard_query(profile: bool):
     use_pallas = on_tpu and kernels.supports(spec, n, batch)
     add_fn = functools.partial(kernels.add if use_pallas else add, spec)
 
-    def one_case(sigma):
+    def one_case(sigma, neg_frac=0.0):
         from sketches_tpu.batched import auto_offset, recenter
 
-        values = jax.jit(
-            lambda k: jnp.exp(
-                jnp.float32(sigma) * jax.random.normal(k, (n, batch), jnp.float32)
+        def gen(k):
+            v = jnp.exp(
+                jnp.float32(sigma)
+                * jax.random.normal(k, (n, batch), jnp.float32)
             )
-        )(jax.random.PRNGKey(0))
+            if neg_frac:
+                sgn = jnp.where(
+                    jax.random.uniform(jax.random.fold_in(k, 1), v.shape)
+                    < neg_frac,
+                    -1.0,
+                    1.0,
+                )
+                v = v * sgn
+            return v
+
+        values = jax.jit(gen)(jax.random.PRNGKey(0))
         # Facade-equivalent auto-centering: the window plan (and therefore
         # the bytes the query reads) depends on where the first batch
         # centers each stream's window.
@@ -403,23 +521,58 @@ def bench_shard_query(profile: bool):
         state = jax.jit(add_fn, donate_argnums=0)(st0, values)
         _sync(state.count[:1])
         qs = jnp.asarray(QS4, jnp.float32)
-        q_fn, plan = _windowed_query_fn(spec, state, use_pallas)
-        query_s = fused_per_iter_s(
-            lambda i, acc, st_, qs_: acc
-            + q_fn(st_, qs_ * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
-            jnp.float32(0.0),
-            iters=64,
-            args=(state, qs),
-        )
-        return state, {
-            "query_sustained_s": round(query_s, 6),
-            "window": plan,
+
+        def sustained(q_fn):
+            return fused_per_iter_s(
+                lambda i, acc, st_, qs_: acc
+                + q_fn(st_, qs_ * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
+                jnp.float32(0.0),
+                iters=64,
+                args=(state, qs),
+            )
+
+        q_win, plan_win = _windowed_query_fn(spec, state, use_pallas)
+        out = {
+            "windowed_sustained_s": round(sustained(q_win), 6),
+            "window": plan_win,
         }
+        if use_pallas:
+            q_tiles, plan_tiles = _tiles_query_fn(spec, state, qs)
+            if q_tiles is not None:
+                out["tiles_sustained_s"] = round(sustained(q_tiles), 6)
+                out["tile_plan"] = plan_tiles
+                # The facade's engine choice (ONE policy home).
+                from sketches_tpu import kernels
+
+                pick = kernels.choose_query_engine(
+                    (plan_win["lo_wblock"], plan_win["n_wblocks"],
+                     plan_win["w_tiles"], plan_win["with_neg"]),
+                    (plan_tiles["k_tiles"], plan_tiles["with_neg"]),
+                )
+                out["facade_engine"] = pick
+                best_fn = q_tiles if pick == "tiles" else q_win
+            else:
+                out["facade_engine"] = "windowed"
+                best_fn = q_win
+            # TRUE device-clocked per-call p50/p99 on the chosen engine
+            # (VERDICT r4 item 4) -- NOT host-timed reps over the tunnel.
+            pcts = device_query_pcts(best_fn, state, qs)
+            if pcts:
+                out["device_query"] = pcts
+        out["query_sustained_s"] = out.get(
+            "tiles_sustained_s"
+            if out.get("facade_engine") == "tiles"
+            else "windowed_sustained_s",
+            out["windowed_sustained_s"],
+        )
+        return state, out
 
     with _maybe_trace(profile, "c2s_shard_query"):
-        # Worst case: a window-filling distribution (sigma=1.5 spans the
-        # whole 512-bin window) -- every bin byte must stream.
-        state, wide = one_case(1.5)
+        # Worst case: window-filling MIXED-SIGN data (every tile of both
+        # stores occupied) -- the r3 verdict's robustness gap.
+        state, worst = one_case(1.5, neg_frac=0.4)
+        # Window-filling positive-only.
+        _, wide = one_case(1.5)
         # Mid occupancy: lognormal sigma=0.3 (~35x value spread) spans 3
         # of 4 window tiles.
         _, mid = one_case(0.3)
@@ -444,6 +597,7 @@ def bench_shard_query(profile: bool):
         "engine": "pallas" if use_pallas else "xla",
         "n_streams": n,
         "state_gb": round(2 * n * 512 * 4 / 1e9, 3),
+        "worst_mixed_sign": worst,
         "wide_window": wide,
         "mid_occupancy": mid,
         "tight_telemetry": tight,
@@ -548,10 +702,13 @@ def bench_distributed(profile: bool):
         )
 
     # Weak-scaling curve: constant per-device shard (streams x batch), so a
-    # flat ingest rate per device = linear scaling.  Query is the full
-    # stream-sharded multi-quantile (embarrassingly parallel; merged_state
-    # is a no-op fold here because value_axis=None).
-    per_dev_streams, batch, iters = 65536, 64, 3
+    # flat ingest rate per device = linear scaling.  The per-device shard is
+    # kept SMALL (8k streams) so the virtual devices' shared host cores
+    # contend as little as possible (VERDICT r3 weak #5: at 65k-stream
+    # shards the query "curve" measured CPU arithmetic contention, not
+    # distribution cost -- the per-chip cost of the stream-sharded query is
+    # the c2s real-chip series, which IS the mesh number).
+    per_dev_streams, batch, iters = 8192, 64, 3
     with _maybe_trace(profile, "c3_distributed"):
         for nd in (1, 2, 4, 8):
             if nd > n_devices:
@@ -586,7 +743,13 @@ def bench_distributed(profile: bool):
                     "devices": nd,
                     "n_streams": n_streams,
                     "ingest_per_s": round(ingest_per_s, 1),
-                    "query_s": round(query_s, 6),
+                    # NOT a distribution-cost curve: virtual devices share
+                    # one host's cores, so this number includes arithmetic
+                    # contention.  It exists to prove the sharded query
+                    # RUNS at every mesh size; per-chip latency comes from
+                    # the real-chip c2s series (stream-sharded queries
+                    # have no collective).
+                    "query_s_host_contended": round(query_s, 6),
                 }
             )
 
@@ -670,7 +833,46 @@ def verify_on_device():
             )
             if not np.allclose(qw, qb, rtol=1e-4, equal_nan=True):
                 failures.append(f"{mapping}/w={weights is not None}/windowed")
+            # The tile-list kernel, same real-hardware Mosaic lowering.
+            k_tiles, wn_t = kernels.plan_tile_query(spec, got, qs)
+            qt = np.asarray(
+                kernels.fused_quantile_tiles(
+                    spec, got, qs, k_tiles=k_tiles, with_neg=wn_t
+                )
+            )
+            if not np.allclose(qt, qb, rtol=1e-4, equal_nan=True):
+                failures.append(f"{mapping}/w={weights is not None}/tiles")
     return "pass" if not failures else "FAIL: " + ",".join(failures)
+
+
+def bench_serde(n: int = 100_000):
+    """Bulk proto serde wall clock (VERDICT r4 item 6): encode + decode of
+    ``n`` streams through the cross-language wire format."""
+    import jax.numpy as jnp
+
+    from sketches_tpu.batched import SketchSpec, add, init
+    from sketches_tpu.pb import batched_from_proto, batched_to_proto
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    vals = np.random.RandomState(0).lognormal(0, 1, (n, 16)).astype(np.float32)
+    state = add(spec, init(spec, n), jnp.asarray(vals))
+    t0 = time.perf_counter()
+    protos = batched_to_proto(spec, state)
+    t1 = time.perf_counter()
+    blobs = [p.SerializeToString() for p in protos]
+    t2 = time.perf_counter()
+    back = batched_from_proto(spec, protos)
+    t3 = time.perf_counter()
+    assert np.allclose(
+        np.asarray(back.bins_pos), np.asarray(state.bins_pos), rtol=1e-6
+    )
+    return {
+        "n_streams": n,
+        "to_proto_s": round(t1 - t0, 3),
+        "serialize_s": round(t2 - t1, 3),
+        "from_proto_s": round(t3 - t2, 3),
+        "bytes_total": sum(len(b) for b in blobs),
+    }
 
 
 def main():
@@ -741,6 +943,7 @@ def main():
                     "c2_c4_1m_streams_cubic_collapsing": c2c4,
                     "c2s_shard_query_131k": c2s,
                     "c3_distributed": c3,
+                    "serde_bulk": bench_serde(),
                 },
                 "membw_read": membw,
                 "verify_pallas_vs_xla_on_device": verify,

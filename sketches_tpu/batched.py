@@ -1174,18 +1174,12 @@ class BatchedDDSketch:
                     self.spec, self.state
                 )
             lo_w, n_w, w_t, with_neg = self._window_plan
-            # Engine choice within Pallas (both measured at the 131k x 512
-            # shard shape): a single-tile occupied window is the windowed
-            # kernel's best case (one wide DMA, no list machinery).  For
-            # wider spans, the tile-list kernel wins when its per-block
-            # needed-tile bound beats the window span (bytes) or when the
-            # negative store participates (the windowed kernel then scans
-            # BOTH spans; the tile fold's per-tile compute is far cheaper).
-            span = n_w * w_t
+            # Engine choice within Pallas: kernels.choose_query_engine is
+            # the one home of the measured tiles-vs-windowed policy.
             if (
                 q_total <= 8
                 and 2 <= self.spec.n_tiles <= 31  # int32 bitmask bound
-                and span > 1
+                and n_w * w_t > 1
             ):
                 # Tile-list plan (list width + store participation)
                 # depends on the requested quantiles: cached per qs tuple.
@@ -1196,9 +1190,10 @@ class BatchedDDSketch:
                     )
                     self._tile_plans[qs_tuple] = plan
                 k_tiles, with_neg_t = plan
-                k_eff = k_tiles * (2 if with_neg_t else 1)
-                win_eff = span * (2 if with_neg else 1)
-                if with_neg_t or k_eff < win_eff:
+                if (
+                    kernels.choose_query_engine(self._window_plan, plan)
+                    == "tiles"
+                ):
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
                     if fn is None:

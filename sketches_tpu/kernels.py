@@ -230,14 +230,18 @@ def _ingest_kernel(
         )
         cols_ref[:] = ident.astype(jnp.float32)
 
-    # A[n, h, s] = (hi[n, s] == h) * w[n, s] in bf16.  Unit weights (w = 1)
-    # are exact in one bf16 term.  Arbitrary f32 weights are split into
-    # three bf16 terms (w = p0 + p1 + p2, successive rounding residuals:
-    # 3 x 8 mantissa bits >= f32's 24, so the split is exact) and the
-    # histogram accumulates one bf16 matmul per term -- full f32 weight
-    # precision at bf16 VMEM footprint, cheaper than a HIGHEST f32 matmul.
-    # Blocks wider than _BS process in _BS-value sub-chunks: one-hot
-    # operands are built (and die) per sub-chunk, so peak VMEM stays at the
+    # A[n, h, s] = (hi[n, s] == h) * w[n, s].  UNIT-weight calls build both
+    # one-hots in INT8 and accumulate on the MXU's int8 path with int32
+    # output -- measured 5x the bf16 matmul throughput (36 vs 7.3 B
+    # bins/s on the isolated histogram at 131k x 512) and exact by
+    # construction (the live/sign mask folds into the hi one-hot, since
+    # unit weights are 0/1).  Arbitrary f32 weights are split into three
+    # bf16 terms (w = p0 + p1 + p2, successive rounding residuals: 3 x 8
+    # mantissa bits >= f32's 24, so the split is exact) and the histogram
+    # accumulates one bf16 matmul per term -- full f32 weight precision at
+    # bf16 VMEM footprint, cheaper than a HIGHEST f32 matmul.  Blocks
+    # wider than _BS process in _BS-value sub-chunks: one-hot operands are
+    # built (and die) per sub-chunk, so peak VMEM stays at the
     # narrow-block level while the grid-iteration count still shrinks.
     #
     # BOTH one-hots lay the value axis on the LANES ([.., ., _BS], iota
@@ -246,25 +250,38 @@ def _ingest_kernel(
     # values on sublanes -- built the same bits 3.5x slower (measured:
     # 153 -> 43 ms per 268M-value pass at 1M x 512); one-hot construction
     # is ~95% of ingest, so the layout IS the throughput.
-    n_terms = 3 if weighted else 1
     hi_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, 2 * hi_size, _BS), 1)
     lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, LO, _BS), 1)
     nt_dims = (((2,), (2,)), ((0,), (0,)))  # contract lanes; batch streams
-    c = jnp.zeros((bn, 2 * hi_size, LO), jnp.float32)
+    acc_dt = jnp.float32 if weighted else jnp.int32
+    c = jnp.zeros((bn, 2 * hi_size, LO), acc_dt)
     for t in range(bs // _BS):
         # lax.slice_in_dim, not mixed None+slice getitem: the latter takes
         # jnp's gather path, which has no general Mosaic lowering.
         hi_t = jax.lax.slice_in_dim(hi, t * _BS, (t + 1) * _BS, axis=1)
         lo_t = jax.lax.slice_in_dim(lo, t * _BS, (t + 1) * _BS, axis=1)
         w_t = jax.lax.slice_in_dim(signed, t * _BS, (t + 1) * _BS, axis=1)
-        onehot_hi = (hi_t[:, None, :] == hi_iota).astype(jnp.bfloat16)
-        onehot_lo = (lo_t[:, None, :] == lo_iota).astype(jnp.bfloat16)
-        for part in _exact_bf16_terms(w_t, n_terms):
-            # bf16 multiply by a 0/1 one-hot is exact.
-            a = onehot_hi * part[:, None, :]  # [BN, 2HI, _BS] bf16
+        if weighted:
+            onehot_hi = (hi_t[:, None, :] == hi_iota).astype(jnp.bfloat16)
+            onehot_lo = (lo_t[:, None, :] == lo_iota).astype(jnp.bfloat16)
+            for part in _exact_bf16_terms(w_t, 3):
+                # bf16 multiply by a 0/1 one-hot is exact.
+                a = onehot_hi * part[:, None, :]  # [BN, 2HI, _BS] bf16
+                c = c + jax.lax.dot_general(
+                    a, onehot_lo, nt_dims, preferred_element_type=jnp.float32
+                )  # [BN, 2HI, LO]
+        else:
+            live_t = (w_t > 0.0)[:, None, :]
+            a8 = jnp.logical_and(
+                hi_t[:, None, :] == hi_iota, live_t
+            ).astype(jnp.int8)
+            b8 = (lo_t[:, None, :] == lo_iota).astype(jnp.int8)
             c = c + jax.lax.dot_general(
-                a, onehot_lo, nt_dims, preferred_element_type=jnp.float32
-            )  # [BN, 2HI, LO]
+                a8, b8, nt_dims, preferred_element_type=jnp.int32
+            )
+    if not weighted:
+        # Exact: per-call counts are bounded by the batch width << 2**31.
+        c = c.astype(jnp.float32)
     # Per-tile masses of this chunk's histogram: a lane reduction over the
     # [bn, 2*HI, LO] block the matmuls just built -- the tile-summary delta
     # (pos rows then neg rows, matching ``SketchState.tile_sums`` layout)
@@ -981,14 +998,52 @@ def _stream_block(n: int) -> int:
     return next((b for b in (1024, 512, 256, 128) if n % b == 0), _BN)
 
 
+def _invalid_mask(state: SketchState, qs: jax.Array) -> jax.Array:
+    """[N, Q] bool: ranks whose output is NaN (empty stream / q outside
+    [0, 1]) -- the ONE definition shared by the tile plan, the list
+    builder, and the kernel's packed nanflag."""
+    return jnp.logical_not(
+        jnp.logical_and(
+            jnp.logical_and(qs >= 0.0, qs <= 1.0)[None, :],
+            (state.count > 0)[:, None],
+        )
+    )
+
+
+def choose_query_engine(window_plan, tile_plan) -> str:
+    """The facades' tiles-vs-windowed policy, in ONE place.
+
+    ``window_plan`` = (lo_w, n_w, w_tiles, with_neg) from
+    :func:`plan_state_window`; ``tile_plan`` = (k_tiles, with_neg) from
+    :func:`plan_tile_query` (or None when ineligible).  Measured basis
+    (131k x 512 v5e shard): a single-tile occupied window is the windowed
+    kernel's best case (one wide DMA, no list machinery); wider spans go
+    to the tile-list kernel when its per-block needed-tile bound beats
+    the span (bytes) or when the negative store participates (the
+    windowed kernel then scans BOTH spans; the tile fold's per-tile
+    compute is far cheaper).
+    """
+    if tile_plan is None:
+        return "windowed"
+    _, n_w, w_t, with_neg_w = window_plan
+    k_tiles, with_neg_t = tile_plan
+    span = n_w * w_t
+    if span <= 1:
+        return "windowed"
+    k_eff = k_tiles * (2 if with_neg_t else 1)
+    win_eff = span * (2 if with_neg_w else 1)
+    return "tiles" if (with_neg_t or k_eff < win_eff) else "windowed"
+
+
 def _tile_targets(spec: SketchSpec, state: SketchState, qs: jax.Array):
     """Per-(stream, q) crossing tiles + thresholds from the summaries.
 
     Pure XLA on [N, T]-sized arrays -- no bin is read.  Returns
-    ``(utile, thr_adj, zflag, g_pos, g_neg)`` where ``utile`` is the
+    ``(utile, thr_adj, zflag, rank)`` where ``utile`` is the
     branch-selected tile id in the unified [0, 2T) space (negative-store
     tiles offset by T), ``thr_adj`` the within-tile rank threshold
-    (``carry`` already subtracted), and ``zflag`` marks zero-bucket ranks.
+    (``carry`` already subtracted), ``zflag`` (f32 0/1) marks zero-bucket
+    ranks, and ``rank`` is the raw [N, Q] rank array.
     All deliberately GATHER-FREE: ``take_along_axis`` with per-row indices
     lowers pathologically on TPU (measured 8 ms for a [131k, 4] gather), so
     every per-(stream, q) lookup is a one-hot contraction over the tiny T
@@ -1029,7 +1084,7 @@ def _tile_targets(spec: SketchSpec, state: SketchState, qs: jax.Array):
     return utile, thr_adj, in_zero.astype(f32), rank
 
 
-def _tile_bits(utile, zflag, n_tiles):
+def _tile_bits(utile, zflag, nanflag, n_tiles):
     """Per-stream needed-tile BITMASKS -> ([N], [N]) int32, one per store
     (bit u of the pos mask = some q targets pos tile u; likewise neg).
 
@@ -1038,11 +1093,14 @@ def _tile_bits(utile, zflag, n_tiles):
     when they materialize at the pallas barrier (measured ~0.25 ms at 131k
     streams), while the bit fold fuses to two thin vectors.  Per-store
     masks keep T <= 31 bits (n_bins <= 3968 -- every window size the tile
-    path serves).
+    path serves).  Zero-bucket AND invalid (empty-stream / out-of-range q)
+    ranks contribute no tile: their outputs ignore the accumulator, and an
+    empty stream's saturated crossing would otherwise add the last tile of
+    each store to every block it sits in (review r4).
     """
     q_total = utile.shape[1]
     t = n_tiles
-    live = zflag < 0.5
+    live = jnp.logical_and(zflag < 0.5, jnp.logical_not(nanflag))
     bits_pos = jnp.zeros(utile.shape[0], jnp.int32)
     bits_neg = jnp.zeros(utile.shape[0], jnp.int32)
     for q in range(q_total):
@@ -1121,7 +1179,10 @@ def plan_tile_query(
 
         def stats(st, qv):
             utile, _, zflag, _ = _tile_targets(spec, st, qv)
-            bits_pos, bits_neg = _tile_bits(utile, zflag, spec.n_tiles)
+            nanflag = _invalid_mask(st, qv)
+            bits_pos, bits_neg = _tile_bits(
+                utile, zflag, nanflag, spec.n_tiles
+            )
             nb = st.n_streams // bn
 
             def max_union(bits):
@@ -1323,7 +1384,8 @@ def fused_quantile_tiles(
         raise ValueError(f"k_tiles={k_tiles} outside [1, {t}]")
 
     utile, thr_adj, zflag, _ = _tile_targets(spec, state, qs)
-    bits_pos, bits_neg = _tile_bits(utile, zflag, t)
+    nanflag = _invalid_mask(state, qs)
+    bits_pos, bits_neg = _tile_bits(utile, zflag, nanflag, t)
     lists_pos, lists_neg = _block_tile_lists(
         bits_pos, bits_neg, t, bn, k_tiles
     )
@@ -1331,12 +1393,6 @@ def fused_quantile_tiles(
     # the kernel emits FINAL values (incl. NaN validity), because any
     # [N, Q]-shaped XLA work after the pallas barrier is left unfused with
     # layout-copy chains (measured 3 ms of 3.8 ms total at 131k streams).
-    nanflag = jnp.logical_not(
-        jnp.logical_and(
-            jnp.logical_and(qs >= 0.0, qs <= 1.0)[None, :],
-            (state.count > 0)[:, None],
-        )
-    )
     f32col = lambda x: x.astype(jnp.float32)[:, None]
     packed = jnp.concatenate(
         [

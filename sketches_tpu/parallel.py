@@ -551,16 +551,13 @@ class DistributedDDSketch:
                     spec, self.merged_state()
                 )
             lo_w, n_w, w_t, with_neg = self._window_plan
-            # Same engine choice as BatchedDDSketch._query_fn: windowed
-            # kernel for single-tile occupied windows; tile-list kernel
-            # when its needed-tile bound beats the span or the negative
-            # store participates.
-            span = n_w * w_t
+            # Engine choice shared with BatchedDDSketch via
+            # kernels.choose_query_engine (the one home of the policy).
             if (
                 q_total <= 8
                 and 2 <= spec.n_tiles <= 31  # int32 bitmask bound
                 and n_local
-                and span > 1
+                and n_w * w_t > 1
             ):
                 bn = kernels._stream_block(n_local)
                 plan = self._tile_plans.get(qs_tuple)
@@ -574,9 +571,10 @@ class DistributedDDSketch:
                     )
                     self._tile_plans[qs_tuple] = plan
                 k_tiles, with_neg_t = plan
-                k_eff = k_tiles * (2 if with_neg_t else 1)
-                win_eff = span * (2 if with_neg else 1)
-                if with_neg_t or k_eff < win_eff:
+                if (
+                    kernels.choose_query_engine(self._window_plan, plan)
+                    == "tiles"
+                ):
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
                     if fn is None:
