@@ -153,3 +153,44 @@ def test_windowed_query_matches_xla(values, seed):
         )
     )
     np.testing.assert_allclose(got, ref, rtol=1e-5, equal_nan=True)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    st.lists(_window_values, min_size=1, max_size=300),
+    st.integers(min_value=0, max_value=2**32 - 1),
+)
+def test_tile_list_query_matches_xla(values, seed):
+    """The hierarchical tile-list kernel agrees with the XLA query on
+    adversarial streams -- drifted windows, sparse/edge occupancy, empty
+    padding streams (VERDICT r4 item 1 hunting ground)."""
+    from sketches_tpu import kernels
+    from sketches_tpu.batched import quantile, recenter
+
+    import jax.numpy as jnp
+
+    vals32 = np.asarray(values, np.float32)
+    vals32 = vals32[np.isfinite(vals32)]
+    if len(vals32) == 0:
+        return
+    spec = SketchSpec(relative_accuracy=ALPHA, n_bins=512)
+    padded = np.zeros((128, len(vals32)), np.float32)
+    padded[0] = vals32
+    w = np.zeros_like(padded)
+    w[0] = 1.0
+    state = add(spec, init(spec, 128), jnp.asarray(padded), jnp.asarray(w))
+    rng = np.random.RandomState(seed)
+    if rng.rand() < 0.5:  # exercise a drifted window position
+        state = recenter(
+            spec, state, state.key_offset + int(rng.randint(-200, 200))
+        )
+    qs = jnp.asarray([0.0, 0.25, 0.5, 0.9, 1.0], jnp.float32)
+    ref = np.asarray(quantile(spec, state, qs))
+    k_tiles, with_neg = kernels.plan_tile_query(spec, state, qs)
+    got = np.asarray(
+        kernels.fused_quantile_tiles(
+            spec, state, qs, k_tiles=k_tiles, with_neg=with_neg,
+            interpret=True,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-5, equal_nan=True)
