@@ -168,6 +168,11 @@ class BaseDDSketch:
             raise UnequalSketchParametersError(
                 "Cannot merge two DDSketches with different parameters"
             )
+        # A jax-backed operand defers its scalar bookkeeping to flush time;
+        # settle it before reading the private fields below.
+        flush = getattr(sketch, "_flush", None)
+        if flush is not None:
+            flush()
         if sketch._count == 0:
             return
 
@@ -224,14 +229,19 @@ class JaxDDSketch(BaseDDSketch):
     chunks (fixed so one jit compilation serves every flush); queries and
     merges flush first.
 
-    Throughput note (measured, BENCH r3): a scalar add loop through this
-    facade runs ~7x SLOWER than the pure-Python host tier (~0.16 M vs
-    ~1.2 M add/s) -- the per-flush device dispatch dominates.  The jax
-    backend exists for *batched* multi-stream throughput; keep scalar
-    single-stream workloads on ``DDSketch``/``NativeDDSketch``.  Scalar bookkeeping (count/sum/min/max) stays in
-    host float64 -- strictly more precise than the reference's -- while bin
-    mass lives on device in float32, which accumulates exactly only up to
-    2**24 (~16.7M) mass per bin (see ``SketchSpec.dtype``).
+    Throughput note (measured, r4): scalar bookkeeping is deferred to the
+    vectorized flush (every accessor flushes first), leaving ``add`` as two
+    list appends -- the loop itself sustains ~2.9 M add/s, with flush-side
+    numpy at ~0.1 us/value.  End-to-end through THIS repo's tunnel-attached
+    chip: ~0.8 M add/s (each flush dispatch pays ~4.5 ms of tunnel; a
+    host-attached deployment pays microseconds, putting it at ~2 M add/s
+    vs the pure-Python tier's ~1.5 M).  For maximum scalar single-stream
+    throughput use ``NativeDDSketch`` (~57 M add/s); the jax backend's
+    real purpose remains *batched* multi-stream work.  Scalar bookkeeping
+    (count/sum/min/max) stays in host float64 -- strictly more precise
+    than the reference's -- while bin mass lives on device in float32,
+    which accumulates exactly only up to 2**24 (~16.7M) mass per bin (see
+    ``SketchSpec.dtype``).
 
     Deliberately *not* a subclass of ``DDSketch``: ``DDSketch.__new__``
     returns one of these when asked for the jax backend, and Python then
@@ -239,7 +249,12 @@ class JaxDDSketch(BaseDDSketch):
     ``DDSketch`` instance.
     """
 
-    _FLUSH_CHUNK = 4096
+    # One jit compilation serves every flush, so the chunk is a fixed
+    # shape.  16k balances dispatch amortization (the dominant cost of the
+    # scalar loop once bookkeeping deferred to flush) against first-flush
+    # latency; the auto-center median only improves with a bigger first
+    # buffer.
+    _FLUSH_CHUNK = 16384
 
     @staticmethod
     @functools.lru_cache(maxsize=None)
@@ -313,36 +328,58 @@ class JaxDDSketch(BaseDDSketch):
     def add(self, val: float, weight: float = 1.0) -> None:
         if weight <= 0.0:
             raise ValueError("weight must be positive")
+        # EVERY piece of scalar bookkeeping happens vectorized at flush
+        # time: the per-add Python arithmetic (and especially the
+        # ``np.float32(val)`` scalar cast zero classification used to do
+        # here) cost several times this whole method.  Measured in this
+        # repo's tunnel-attached environment: 0.16-0.32 M add/s before
+        # (r3/r4 runs of bench c0_jax_scalar) -> ~0.8 M after, with the
+        # add loop itself at ~2.9 M/s (the rest is per-flush dispatch).
+        # Every accessor (incl. __repr__ and the store views) flushes
+        # first, so no counter is ever observably stale.
         self._pending_vals.append(val)
         self._pending_weights.append(weight)
-        self._host_cache = None
-        self._count += weight
-        self._sum += val * weight
-        if val < self._min:
-            self._min = val
-        if val > self._max:
-            self._max = val
-        # Classify zero with the *device's* semantics -- f32 cast plus the
-        # TPU/XLA flush-to-zero treatment of subnormals -- not the host
-        # mapping's f64 min_possible: anything the device lands in its zero
-        # path must count as zero here too, or cross-backend merges drop
-        # that mass.  Subnormal f32 magnitudes (< ~1.18e-38) flush on
-        # device; NaN fails the >= comparison and lands here as well.
-        if not abs(float(np.float32(val))) >= _F32_TINY:
-            self._zero_count += weight
         if len(self._pending_vals) >= self._FLUSH_CHUNK:
             self._flush()
 
     def _flush(self) -> None:
+        if not self._pending_vals:
+            return
+        self._host_cache = None
         while self._pending_vals:
             chunk_v = self._pending_vals[: self._FLUSH_CHUNK]
             chunk_w = self._pending_weights[: self._FLUSH_CHUNK]
             del self._pending_vals[: self._FLUSH_CHUNK]
             del self._pending_weights[: self._FLUSH_CHUNK]
+            # ONE Python-list walk per chunk: the f64 arrays are the
+            # master copies, and the f32 device buffers derive from them
+            # by numpy downcast (bit-identical to casting the list
+            # directly, so the device zero-classification semantics below
+            # are unchanged).
+            v64 = np.asarray(chunk_v, np.float64)
+            w64 = np.asarray(chunk_w, np.float64)
             values = np.zeros((1, self._FLUSH_CHUNK), np.float32)
             weights = np.zeros((1, self._FLUSH_CHUNK), np.float32)
-            values[0, : len(chunk_v)] = chunk_v
-            weights[0, : len(chunk_w)] = chunk_w
+            values[0, : len(chunk_v)] = v64
+            weights[0, : len(chunk_w)] = w64
+            self._count += float(w64.sum())
+            self._sum += float((v64 * w64).sum())  # NaN poisons, as before
+            finite = ~np.isnan(v64)
+            if finite.any():
+                self._min = min(self._min, float(v64[finite].min()))
+                self._max = max(self._max, float(v64[finite].max()))
+            # Classify zeros with the *device's* semantics -- the f32 cast
+            # (done by the array assignment above) plus the TPU/XLA
+            # flush-to-zero treatment of subnormals -- not the host
+            # mapping's f64 min_possible: anything the device lands in its
+            # zero path must count as zero here too, or cross-backend
+            # merges drop that mass.  Subnormal f32 magnitudes
+            # (< ~1.18e-38) flush on device; NaN fails the >= comparison
+            # and counts as zero as well.
+            chunk_vals = values[0, : len(chunk_v)]
+            zero_lanes = ~(np.abs(chunk_vals) >= _F32_TINY)
+            if zero_lanes.any():
+                self._zero_count += float(w64[zero_lanes].sum())
             if self._auto_center_pending:
                 self._auto_center_pending = False
                 self._state = self._first_flush_fn(self._state, values, weights)
@@ -350,9 +387,9 @@ class JaxDDSketch(BaseDDSketch):
                 self._state = self._flush_fn(self._state, values, weights)
 
     def get_quantile_value(self, quantile: float) -> typing.Optional[float]:
+        self._flush()  # also settles the deferred _count bookkeeping
         if quantile < 0 or quantile > 1 or self._count == 0:
             return None
-        self._flush()
         out = float(self._quantile_fn(self._state, float(quantile))[0])
         return out
 
@@ -415,14 +452,47 @@ class JaxDDSketch(BaseDDSketch):
         return new
 
     # -- accessors (BaseDDSketch properties read these fields) -------------
+    @property
+    def zero_count(self) -> float:
+        # ALL scalar bookkeeping happens at flush time (vectorized); each
+        # accessor flushes so no counter is observably stale.
+        self._flush()
+        return self._zero_count
+
+    @property
+    def count(self) -> float:
+        self._flush()
+        return self._count
+
+    @property
+    def num_values(self) -> float:
+        self._flush()
+        return self._count
+
+    @property
+    def sum(self) -> float:  # noqa: A003 - reference API name
+        self._flush()
+        return self._sum
+
+    @property
+    def avg(self) -> float:
+        self._flush()
+        return self._sum / self._count
+
+    def __repr__(self) -> str:
+        self._flush()  # the inherited repr reads the deferred counters
+        return super().__repr__()
+
     def _host_view(self) -> "BaseDDSketch":
         """Host materialization of the device bins, cached until the next
         mutation so back-to-back store/negative_store reads pay for one
-        device transfer, not two."""
+        device transfer, not two.  Flush FIRST, unconditionally: it clears
+        the cache whenever adds were pending, so a view can never miss
+        buffered values (review r4)."""
+        self._flush()
         if self._host_cache is None:
             from sketches_tpu.batched import to_host_sketches
 
-            self._flush()
             self._host_cache = to_host_sketches(self._spec, self._state)[0]
         return self._host_cache
 
