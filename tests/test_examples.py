@@ -12,7 +12,12 @@ _EXAMPLES = os.path.join(
 
 
 @pytest.mark.parametrize(
-    "script", ["latency_monitoring.py", "distributed_mesh.py"]
+    "script",
+    [
+        "latency_monitoring.py",
+        "distributed_mesh.py",
+        "heterogeneous_fleet.py",
+    ],
 )
 def test_example_runs_clean(script):
     env = dict(os.environ)
