@@ -1245,13 +1245,19 @@ def _tiles_kernel(
     def fold(list_ref, blk, id_offset):
         pid = list_ref[i, j]
         # First-occurrence gate: list pads repeat their predecessor (the
-        # repeat's DMA elides), and a repeated tile must not re-accumulate.
+        # repeat's DMA elides), and a repeated tile must not re-fold.
         fresh = jnp.logical_or(
             j == 0, pid != list_ref[i, jnp.maximum(j - 1, 0)]
         )
         pid_f = (pid + id_offset).astype(jnp.float32)
         for q in range(q_total):
             m = jnp.logical_and(fresh, utile[:, q : q + 1] == pid_f)
+            # Mask-multiply-accumulate, deliberately: each slab row
+            # receives at most one tile, so a select-copy
+            # (``where(m, blk, acc)``) is semantically equal -- but it
+            # measures 0.45 ms SLOWER device-clocked at the worst-case
+            # shard shape (2.75 vs 2.30 ms): the VPU fuses the
+            # mask-mult-add, while the select forces a read-modify-write.
             acc[q * bn : (q + 1) * bn, :] += m.astype(jnp.float32) * blk
 
     fold(lp_ref, bp_ref[:], 0)
