@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 
-__all__ = ["save", "restore", "save_state", "restore_state"]
+__all__ = ["save", "restore", "restore_distributed", "save_state", "restore_state"]
 
 _FIELDS = [f.name for f in dataclasses.fields(SketchState)]
 
@@ -115,4 +115,34 @@ def restore(path: str, engine: str = "auto") -> BatchedDDSketch:
     spec, state = restore_state(path)
     return BatchedDDSketch(
         state.n_streams, spec=spec, state=state, engine=engine
+    )
+
+
+def restore_distributed(
+    path: str,
+    mesh=None,
+    value_axis="values",
+    stream_axis=None,
+    engine: str = "auto",
+):
+    """Resume a checkpoint as a mesh-sharded ``DistributedDDSketch``.
+
+    The saved state is the FOLDED batch (``save`` folds partials before
+    writing); ``DistributedDDSketch.from_merged_state`` loads it into
+    value-shard 0's partial (the other shards hold the fold's
+    identities), so the psum fold reproduces the saved totals exactly and
+    subsequent ingest spreads new mass across shards as usual.  The
+    mesh/axes may differ from the mesh the checkpoint was written under
+    (the wire carries no topology -- state is topology-free by design).
+    """
+    from sketches_tpu.parallel import DistributedDDSketch
+
+    spec, state = restore_state(path)
+    return DistributedDDSketch.from_merged_state(
+        state,
+        spec,
+        mesh=mesh,
+        value_axis=value_axis,
+        stream_axis=stream_axis,
+        engine=engine,
     )

@@ -732,6 +732,57 @@ class DistributedDDSketch:
             return True
         return False
 
+    @classmethod
+    def from_merged_state(
+        cls,
+        state: SketchState,
+        spec: SketchSpec,
+        mesh: Optional[Mesh] = None,
+        value_axis: Optional[str] = "values",
+        stream_axis: Optional[str] = None,
+        engine: str = "auto",
+    ) -> "DistributedDDSketch":
+        """Build a mesh-sharded facade holding a FOLDED batch (the inverse
+        of ``merged_state`` -- checkpoint resume, ``to_batched`` undo).
+
+        The state loads into value-shard 0's partial; the other shards
+        keep their init values, which are the fold's identities (zero
+        mass, +-inf extrema, empty-span sentinels), so the psum fold
+        reproduces the loaded totals exactly.  ``key_offset`` is the one
+        field that must be IDENTICAL on every partial (``psum_merge``
+        folds it with pmax under that invariant), so the loaded
+        per-stream offsets broadcast to all shards.  The mesh/axes may
+        differ from wherever the state came from -- it is topology-free.
+        """
+        import dataclasses
+
+        dist = cls(
+            state.n_streams,
+            mesh=mesh,
+            value_axis=value_axis,
+            stream_axis=stream_axis,
+            spec=spec,
+            engine=engine,
+        )
+
+        def load_slot0(partials, st):
+            new = jax.tree.map(lambda p, s: p.at[0].set(s), partials, st)
+            off = jnp.broadcast_to(
+                st.key_offset[None], partials.key_offset.shape
+            )
+            return dataclasses.replace(new, key_offset=off)
+
+        loaded = jax.jit(load_slot0)(dist.partials, state)
+        # Pin the canonical partial sharding explicitly: the donated
+        # ingest jits were traced against it, and an implicitly-propagated
+        # layout could diverge.
+        sharding = jax.tree.map(
+            lambda ps: NamedSharding(dist.mesh, ps),
+            _state_pspec(value_axis, stream_axis),
+        )
+        dist.partials = jax.device_put(loaded, sharding)
+        return dist
+
     def to_batched(self) -> BatchedDDSketch:
         """Materialize as a single-batch facade (for serde / checkpointing).
 
