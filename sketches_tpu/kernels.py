@@ -1250,15 +1250,19 @@ def _tiles_kernel(
             j == 0, pid != list_ref[i, jnp.maximum(j - 1, 0)]
         )
         pid_f = (pid + id_offset).astype(jnp.float32)
+        # ONE thin compare+cast for all Q (narrow [bn, Q] vectors occupy
+        # the same vreg count as [bn, 1]); measured runtime-neutral at the
+        # worst-case shard shape -- the wide per-q mask-mult-adds below
+        # fully dominate the fold -- but it keeps the per-cell IR minimal.
+        mf = jnp.where(fresh, (utile == pid_f).astype(jnp.float32), 0.0)
         for q in range(q_total):
-            m = jnp.logical_and(fresh, utile[:, q : q + 1] == pid_f)
             # Mask-multiply-accumulate, deliberately: each slab row
             # receives at most one tile, so a select-copy
             # (``where(m, blk, acc)``) is semantically equal -- but it
             # measures 0.45 ms SLOWER device-clocked at the worst-case
             # shard shape (2.75 vs 2.30 ms): the VPU fuses the
             # mask-mult-add, while the select forces a read-modify-write.
-            acc[q * bn : (q + 1) * bn, :] += m.astype(jnp.float32) * blk
+            acc[q * bn : (q + 1) * bn, :] += mf[:, q : q + 1] * blk
 
     fold(lp_ref, bp_ref[:], 0)
     if with_neg:
