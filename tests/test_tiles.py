@@ -207,3 +207,20 @@ def test_plan_tile_query_k_bounds():
     st2 = _mk(spec, 256, REGIMES["mixed_sign"])
     k2, wn2 = kernels.plan_tile_query(spec, st2, QS)
     assert 1 <= k2 <= spec.n_tiles and wn2 is True
+
+
+def test_choose_query_engine_policy():
+    """The ONE policy home: single-tile windows stay windowed; the tile
+    engine takes negative-store participation or a strict byte win."""
+    choose = kernels.choose_query_engine
+    # span <= 1 -> windowed regardless of the tile plan.
+    assert choose((0, 1, 1, False), (1, False)) == "windowed"
+    assert choose((0, 1, 1, True), (1, True)) == "windowed"
+    # No tile plan -> windowed.
+    assert choose((0, 2, 2, False), None) == "windowed"
+    # Negative store participating -> tiles (windowed scans both spans).
+    assert choose((0, 1, 4, True), (4, True)) == "tiles"
+    # Byte win: k_eff < win_eff.
+    assert choose((0, 3, 1, False), (1, False)) == "tiles"
+    # No byte win, no neg -> windowed (equal tiles read, cheaper walk).
+    assert choose((0, 1, 4, False), (4, False)) == "windowed"
