@@ -30,6 +30,7 @@ enum MappingKind {
   kLogarithmic = 0,
   kLinearInterpolated = 1,
   kCubicInterpolated = 2,
+  kQuadraticInterpolated = 3,
 };
 
 // Cubic-interpolation coefficients (mapping.py . CubicallyInterpolatedMapping).
@@ -77,6 +78,13 @@ inline double log_gamma(const Sketch& s, double v) {
       const double m = std::frexp(v, &e);
       return (cubic(2.0 * m - 1.0) + (e - 1)) * s.multiplier;
     }
+    case kQuadraticInterpolated: {
+      // mapping.py . QuadraticallyInterpolatedMapping: f(t) = t*(4-t)/3.
+      int e;
+      const double m = std::frexp(v, &e);
+      const double t = 2.0 * m - 1.0;
+      return (t * (4.0 - t) / 3.0 + (e - 1)) * s.multiplier;
+    }
     default:
       return std::log(v) * s.multiplier;
   }
@@ -98,6 +106,13 @@ inline double pow_gamma(const Sketch& s, double x) {
       for (int i = 0; i < kNewtonIters; ++i) {
         t = t - (cubic(t) - rem) / cubic_deriv(t);
       }
+      return std::ldexp((t + 1.0) / 2.0, static_cast<int>(e) + 1);
+    }
+    case kQuadraticInterpolated: {
+      // Closed-form inverse of t*(4-t)/3 = rem on [0, 1).
+      const double e = std::floor(v);
+      const double rem = v - e;
+      const double t = 2.0 - std::sqrt(4.0 - 3.0 * rem);
       return std::ldexp((t + 1.0) / 2.0, static_cast<int>(e) + 1);
     }
     default:
@@ -157,7 +172,7 @@ extern "C" {
 void* sketch_create(double relative_accuracy, int n_bins, int key_offset,
                     int mapping_kind) {
   if (relative_accuracy <= 0.0 || relative_accuracy >= 1.0 || n_bins < 2 ||
-      mapping_kind < kLogarithmic || mapping_kind > kCubicInterpolated) {
+      mapping_kind < kLogarithmic || mapping_kind > kQuadraticInterpolated) {
     return nullptr;
   }
   auto* s = new Sketch();
@@ -172,6 +187,10 @@ void* sketch_create(double relative_accuracy, int n_bins, int key_offset,
     // Bucket-width guarantee for the cubic log2 approximation
     // (mapping.py: multiplier *= 7/10 -- the f'(0) * ln2 derivative bound).
     s->multiplier *= 7.0 / 10.0;
+  } else if (mapping_kind == kQuadraticInterpolated) {
+    // Quadratic bucket-width guarantee: kappa = 3/4 (endpoint-equalized
+    // max-min of f'(t)*(1+t) -- mapping.py's forcing argument).
+    s->multiplier *= 3.0 / 4.0;
   }
   s->pos.assign(n_bins, 0.0);
   s->neg.assign(n_bins, 0.0);

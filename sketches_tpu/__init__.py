@@ -27,6 +27,7 @@ from sketches_tpu.mapping import (
     KeyMapping,
     LinearlyInterpolatedMapping,
     LogarithmicMapping,
+    QuadraticallyInterpolatedMapping,
 )
 from sketches_tpu.store import (
     CollapsingHighestDenseStore,
@@ -49,6 +50,7 @@ __all__ = [
     "KeyMapping",
     "LogarithmicMapping",
     "LinearlyInterpolatedMapping",
+    "QuadraticallyInterpolatedMapping",
     "CubicallyInterpolatedMapping",
     "Store",
     "DenseStore",

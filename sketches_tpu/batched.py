@@ -337,9 +337,14 @@ def occupied_bounds_np(bins: np.ndarray):
     agreeing on these sentinels.
     """
     n_bins = bins.shape[-1]
-    iota = np.arange(n_bins, dtype=np.int32)
-    lo = np.where(bins > 0, iota, n_bins).min(axis=-1).astype(np.int32)
-    hi = np.where(bins > 0, iota, -1).max(axis=-1).astype(np.int32)
+    occ = bins > 0
+    any_ = occ.any(axis=-1)
+    # argmax on bool = first/last True: fewer and smaller temps than the
+    # where(iota) min/max formulation (bulk-serde hot path).
+    lo = np.where(any_, occ.argmax(axis=-1), n_bins).astype(np.int32)
+    hi = np.where(
+        any_, n_bins - 1 - occ[..., ::-1].argmax(axis=-1), -1
+    ).astype(np.int32)
     return lo, hi
 
 
@@ -1174,12 +1179,11 @@ class BatchedDDSketch:
                     self.spec, self.state
                 )
             lo_w, n_w, w_t, with_neg = self._window_plan
-            # Engine choice within Pallas: kernels.choose_query_engine is
-            # the one home of the measured tiles-vs-windowed policy.
-            if (
-                q_total <= 8
-                and 2 <= self.spec.n_tiles <= 31  # int32 bitmask bound
-                and n_w * w_t > 1
+            # Eligibility and engine choice both live in kernels
+            # (tile_query_eligible / choose_query_engine) so the two
+            # facades can never drift apart on the policy (ADVICE r4).
+            if kernels.tile_query_eligible(
+                self.spec, q_total, self._window_plan
             ):
                 # Tile-list plan (list width + store participation)
                 # depends on the requested quantiles: cached per qs tuple.
@@ -1642,10 +1646,33 @@ def from_host_sketches(spec: SketchSpec, sketches) -> SketchState:
         # Round-trip the device-only collapse counters when present.
         clow[i] += getattr(sk, "_collapsed_low", 0.0)
         chigh[i] += getattr(sk, "_collapsed_high", 0.0)
+    return arrays_to_state(
+        spec, bins_pos, bins_neg, zero, count, total, vmin, vmax, clow, chigh
+    )
+
+
+def arrays_to_state(
+    spec: SketchSpec,
+    bins_pos: np.ndarray,
+    bins_neg: np.ndarray,
+    zero: np.ndarray,
+    count: np.ndarray,
+    total: np.ndarray,
+    vmin: np.ndarray,
+    vmax: np.ndarray,
+    clow: np.ndarray,
+    chigh: np.ndarray,
+) -> SketchState:
+    """Pack host (f64) interop arrays into a device state on the spec's
+    default window -- the shared tail of every host->device lift
+    (:func:`from_host_sketches`, ``pb.wire``'s bulk decode): derived
+    counters (occupied bounds, neg_total, tile summaries) recompute from
+    the bins, and masses cast to the spec's bin dtype (rounded for integer
+    bins -- fractional host weights are outside integer mode's contract).
+    """
+    n = bins_pos.shape[0]
     bd = np.dtype(jnp.dtype(spec.bin_dtype).name)
     if np.issubdtype(bd, np.integer):
-        # Host (f64) masses round to the nearest integer for integer-bin
-        # specs; fractional host weights are outside integer mode's contract.
         cast = lambda a: jnp.asarray(np.rint(a).astype(bd))
     else:
         cast = lambda a: jnp.asarray(a.astype(bd))

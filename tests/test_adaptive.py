@@ -346,7 +346,9 @@ def test_jax_sketch_explicit_offset_pins_window():
     sk = JaxDDSketch(0.01, n_bins=128, key_offset=-64)
     for _ in range(10):
         sk.add(1e9)
-    sk._flush()
+    # _settle, not _flush: with the native flush buffer (r5) the device
+    # state materializes lazily at settle time.
+    sk._settle()
     assert float(sk._state.collapsed_high[0]) == pytest.approx(10.0)
 
 

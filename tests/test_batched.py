@@ -71,7 +71,7 @@ def test_all_distributions_one_batch(size):
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 def test_mappings_on_device_path(mapping):
     spec = SketchSpec(
@@ -238,7 +238,7 @@ def test_int_values_with_fractional_weights():
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 def test_to_host_respects_spec_mapping(mapping):
     spec = SketchSpec(relative_accuracy=0.05, n_bins=512, mapping_name=mapping)

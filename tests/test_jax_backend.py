@@ -218,7 +218,7 @@ def test_f32_subnormal_classified_zero_on_both_sides():
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 def test_mapping_choice_on_jax_backend(mapping):
     # VERDICT round 1 item 5: the jax backend accepts a mapping choice.
@@ -305,7 +305,7 @@ def test_subclass_jax_backend_is_loud_and_degenerate_bin_limit_defaults():
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 def test_ddsketch_jax_backend_full_spec_seam(mapping):
     # VERDICT round 2 item 6: the DDSketch(...) facade itself accepts the
@@ -365,3 +365,13 @@ def test_jax_only_kwargs_rejected_on_py_backend():
         sketches_tpu.LogCollapsingHighestDenseDDSketch(
             REL_ACC, mapping="logarithmic"
         )
+def test_jax_sketch_inf_first_chunk():
+    """A first flush chunk whose median live |v| is infinite must not
+    crash the native auto-center (review r5: OverflowError from
+    math.ceil(inf))."""
+    from sketches_tpu.ddsketch import JaxDDSketch
+
+    sk = JaxDDSketch(0.01, n_bins=128)
+    for _ in range(JaxDDSketch._FLUSH_CHUNK + 1):
+        sk.add(float("inf"))
+    assert sk.count == JaxDDSketch._FLUSH_CHUNK + 1

@@ -131,7 +131,7 @@ def test_weighted_adds_stay_exact_through_pallas():
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 def test_weighted_ingest_and_quantile_parity_all_mappings(mapping):
     """Every mapping x arbitrary f32 weights: kernel == XLA engine."""

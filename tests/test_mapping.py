@@ -13,10 +13,16 @@ from sketches_tpu.mapping import (
     KeyMapping,
     LinearlyInterpolatedMapping,
     LogarithmicMapping,
+    QuadraticallyInterpolatedMapping,
     mapping_from_name,
 )
 
-MAPPINGS = [LogarithmicMapping, LinearlyInterpolatedMapping, CubicallyInterpolatedMapping]
+MAPPINGS = [
+    LogarithmicMapping,
+    LinearlyInterpolatedMapping,
+    QuadraticallyInterpolatedMapping,
+    CubicallyInterpolatedMapping,
+]
 ACCURACIES = [1e-1, 2e-2, 1e-2, 1e-3]
 
 
@@ -115,6 +121,7 @@ def test_registry():
     for name, cls in [
         ("logarithmic", LogarithmicMapping),
         ("linear_interpolated", LinearlyInterpolatedMapping),
+        ("quadratic_interpolated", QuadraticallyInterpolatedMapping),
         ("cubic_interpolated", CubicallyInterpolatedMapping),
     ]:
         m = mapping_from_name(name, 0.05)
@@ -137,7 +144,12 @@ def test_f64_array_path_under_x64():
     import jax
 
     with jax.enable_x64(True):
-        for name in ("linear_interpolated", "cubic_interpolated", "logarithmic"):
+        for name in (
+            "linear_interpolated",
+            "quadratic_interpolated",
+            "cubic_interpolated",
+            "logarithmic",
+        ):
             m = mapping_from_name(name, 0.01)
             vals = np.asarray([1e-100, 1e-3, 1.0, 7.5, 1e100], np.float64)
             keys = m.key_array(jnp.asarray(vals))

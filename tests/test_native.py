@@ -117,7 +117,7 @@ def test_nan_goes_to_zero_bucket():
     assert sk.zero_count == 1.0
 
 
-ALL_MAPPINGS = ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+ALL_MAPPINGS = ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 
 
 @pytest.mark.parametrize("mapping", ALL_MAPPINGS)

@@ -80,8 +80,21 @@ def test_sparse_bincounts_decode():
     assert sk.store.count == pytest.approx(3.0)
 
 
-def test_unsupported_interpolation_raises():
+def test_quadratic_interpolation_decodes():
+    # Every enum value the wire schema names decodes (QUADRATIC since r5);
+    # a value outside the schema (proto3 open enums preserve unknown ints)
+    # still raises loudly.
     proto = pb.IndexMapping(gamma=1.02, interpolation=pb.IndexMapping.QUADRATIC)
+    from sketches_tpu.mapping import QuadraticallyInterpolatedMapping
+
+    m = KeyMappingProto.from_proto(proto)
+    assert isinstance(m, QuadraticallyInterpolatedMapping)
+    assert m.gamma == pytest.approx(1.02, rel=1e-12)
+
+
+def test_unsupported_interpolation_raises():
+    proto = pb.IndexMapping(gamma=1.02)
+    proto.ParseFromString(proto.SerializeToString() + b"\x18\x07")  # enum = 7
     with pytest.raises(ValueError, match="interpolation"):
         KeyMappingProto.from_proto(proto)
 

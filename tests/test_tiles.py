@@ -209,6 +209,56 @@ def test_plan_tile_query_k_bounds():
     assert 1 <= k2 <= spec.n_tiles and wn2 is True
 
 
+@pytest.mark.parametrize("n_bins", [4096, 8192])
+def test_tiles_parity_wide_windows(n_bins):
+    """Multi-word needed-tile masks (VERDICT r4 item 7): the tile engine
+    must serve 4096/8192-bin windows (32/64 tiles -- past the old int32
+    single-word cap), including occupancy in tiles >= 32 (word 1+)."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=n_bins)
+    st = init(spec, 128)
+    rng = np.random.RandomState(13)
+    v = (
+        rng.lognormal(0, 3.0, (128, 512))
+        * np.where(rng.rand(128, 512) < 0.4, -1.0, 1.0)
+    ).astype(np.float32)
+    st = add(spec, st, jnp.asarray(v))
+    # Slide the window so the occupied span sits in the top tiles: tile 31
+    # is the bit the old signed-int32 mask could not carry (1 << 31
+    # overflows), and at 8192 bins tiles >= 32 exercise word 1 outright.
+    st = recenter(spec, st, st.key_offset - jnp.int32(n_bins // 2 - 500))
+    hi_tiles = int(np.asarray(st.occ_hi).max()) // 128
+    assert hi_tiles >= spec.n_tiles - 2, hi_tiles
+    assert kernels.tile_query_eligible(
+        spec, QS.shape[0], kernels.plan_state_window(spec, st)
+    )
+    ref = np.asarray(quantile(spec, st, QS))
+    k_tiles, with_neg = kernels.plan_tile_query(spec, st, QS)
+    got = np.asarray(
+        kernels.fused_quantile_tiles(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    # rtol 1e-5, not the narrow tests' 1e-6: at |key| ~ 2400 the decode's
+    # exp argument k/multiplier ~ 48 carries ~|x| * 2**-24 ~ 3e-6 relative
+    # error from f32 argument rounding, and the two paths fuse the divide
+    # differently on the CPU backend (on TPU the same data matches at
+    # 1e-6).  Still 3 orders below a bucket width (2 * alpha).
+    np.testing.assert_allclose(got, ref, rtol=1e-5, equal_nan=True)
+
+
+def test_tile_query_eligible_bounds():
+    """The shared eligibility predicate (ADVICE r4): Q cap, tiny windows,
+    single-tile spans, and the lifted 31-tile bound."""
+    eligible = kernels.tile_query_eligible
+    wide = SketchSpec(relative_accuracy=0.01, n_bins=8192)
+    assert eligible(wide, 4, (0, 2, 2, False))
+    assert not eligible(wide, 9, (0, 2, 2, False))  # Q cap (VMEM slab)
+    assert not eligible(wide, 4, (0, 1, 1, False))  # single-tile span
+    assert not eligible(wide, 4, None)  # no window plan yet
+    tiny = SketchSpec(relative_accuracy=0.01, n_bins=128)
+    assert not eligible(tiny, 4, (0, 1, 1, False))  # one tile per store
+
+
 def test_choose_query_engine_policy():
     """The ONE policy home: single-tile windows stay windowed; the tile
     engine takes negative-store participation or a strict byte win."""

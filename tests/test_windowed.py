@@ -44,7 +44,7 @@ def _windowed(spec, st, qs, with_neg=True):
 
 
 @pytest.mark.parametrize(
-    "mapping", ["logarithmic", "linear_interpolated", "cubic_interpolated"]
+    "mapping", ["logarithmic", "linear_interpolated", "quadratic_interpolated", "cubic_interpolated"]
 )
 @pytest.mark.parametrize("sigma", [0.3, 2.5])
 def test_parity_vs_xla(mapping, sigma):

@@ -27,6 +27,7 @@ from sketches_tpu import DDSketch
 from sketches_tpu.mapping import (
     CubicallyInterpolatedMapping,
     LogarithmicMapping,
+    QuadraticallyInterpolatedMapping,
 )
 from sketches_tpu.pb import DDSketchProto, batched_from_proto
 from sketches_tpu.pb import ddsketch_pb2 as pb
@@ -191,6 +192,53 @@ def test_golden_cubic_dense_run_with_offset():
     sk = decode(blob)
     assert isinstance(sk.mapping, CubicallyInterpolatedMapping)
     _check_quantiles(sk, CubicallyInterpolatedMapping(ALPHA), pos, {}, 0.0)
+
+
+def test_golden_quadratic_sparse_and_dense():
+    """QUADRATIC sketch (wire enum 2) from foreign bytes: sparse map in the
+    negative store, dense run in the positive store, nonzero zeroCount.
+    Decodes unconditionally (the alpha-optimal quadratic's constants are
+    forced -- see ``mapping.QuadraticallyInterpolatedMapping``) and answers
+    within alpha."""
+    counts = [2.0, 1.0, 0.0, 4.0]
+    off = 5
+    pos = {off + i: c for i, c in enumerate(counts) if c > 0}
+    neg = {-8: 2.5, 3: 1.0}
+    blob = ddsketch_bytes(
+        index_mapping_bytes(GAMMA, 2),
+        pos=store_bytes(contiguous=counts, offset=off),
+        neg=store_bytes(bin_counts=neg),
+        zero_count=2.0,
+    )
+    sk = decode(blob)
+    assert isinstance(sk.mapping, QuadraticallyInterpolatedMapping)
+    assert sk.count == pytest.approx(12.5)
+    _check_quantiles(sk, QuadraticallyInterpolatedMapping(ALPHA), pos, neg, 2.0)
+
+
+def test_quadratic_round_trip():
+    """Native quadratic sketch -> bytes -> decode: same bins, same enum."""
+    from sketches_tpu.ddsketch import BaseDDSketch
+    from sketches_tpu.store import DenseStore
+
+    m = QuadraticallyInterpolatedMapping(ALPHA)
+    sk = BaseDDSketch(mapping=m, store=DenseStore(), negative_store=DenseStore())
+    rng = np.random.default_rng(7)
+    for v in rng.lognormal(0.0, 2.0, 500):
+        sk.add(float(v))
+    for v in rng.lognormal(0.0, 1.0, 100):
+        sk.add(-float(v))
+    sk.add(0.0, 3.0)
+    msg = DDSketchProto.to_proto(sk)
+    assert msg.mapping.interpolation == pb.IndexMapping.QUADRATIC
+    back = DDSketchProto.from_proto(pb.DDSketch.FromString(msg.SerializeToString()))
+    assert isinstance(back.mapping, QuadraticallyInterpolatedMapping)
+    assert back.mapping.gamma == pytest.approx(m.gamma, rel=1e-12)
+    assert back.count == pytest.approx(sk.count)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+        assert back.get_quantile_value(q) == pytest.approx(
+            sk.get_quantile_value(q), rel=1e-9
+        )
 
 
 def test_golden_mixed_sparse_plus_dense_unpacked():
