@@ -1051,12 +1051,15 @@ def choose_query_engine(window_plan, tile_plan) -> str:
     ``window_plan`` = (lo_w, n_w, w_tiles, with_neg) from
     :func:`plan_state_window`; ``tile_plan`` = (k_tiles, with_neg) from
     :func:`plan_tile_query` (or None when ineligible).  Measured basis
-    (131k x 512 v5e shard): a single-tile occupied window is the windowed
-    kernel's best case (one wide DMA, no list machinery); wider spans go
-    to the tile-list kernel when its per-block needed-tile bound beats
-    the span (bytes) or when the negative store participates (the
-    windowed kernel then scans BOTH spans; the tile fold's per-tile
-    compute is far cheaper).
+    (131k x 512 v5e shard, re-measured r5 after the decode cut): a
+    single-tile occupied window is the windowed kernel's best case (one
+    wide DMA, no list machinery; 0.15 ms sustained vs the tile kernel's
+    1.35 on tight telemetry); wider spans go to the tile-list kernel when
+    its per-block needed-tile bound is at or below the span (equal-bytes
+    ties now favor tiles: at the 4-tile positive-only window the tile
+    kernel measures 0.99 ms sustained vs windowed 1.36 -- the r4 basis
+    predated the cheaper shared decode) or when the negative store
+    participates (the windowed kernel then scans BOTH spans).
     """
     if tile_plan is None:
         return "windowed"
@@ -1067,7 +1070,7 @@ def choose_query_engine(window_plan, tile_plan) -> str:
         return "windowed"
     k_eff = k_tiles * (2 if with_neg_t else 1)
     win_eff = span * (2 if with_neg_w else 1)
-    return "tiles" if (with_neg_t or k_eff < win_eff) else "windowed"
+    return "tiles" if (with_neg_t or k_eff <= win_eff) else "windowed"
 
 
 def _tile_targets(spec: SketchSpec, state: SketchState, qs: jax.Array):

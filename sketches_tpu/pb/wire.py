@@ -364,6 +364,92 @@ class _Decoder:
         )
 
 
+def _parse_canonical(blob: bytes, start: int, i: int, base: int):
+    """Walk one canonical blob past its mapping prefix.
+
+    Returns ``(pending, zero_count)`` -- ``pending`` holds
+    ``((is_neg, trimmed_len), (stream, window_start, payload view))`` per
+    store run -- or ``None`` for ANY non-canonical shape: unknown fields,
+    repeated store fields (legal protobuf, but the group scatter assumes
+    one run per (stream, store)), and declared lengths that leave the
+    blob (review r5: a truncated blob must reach the careful path, whose
+    ``FromString`` raises DecodeError, never be silently slice-clamped
+    into a shorter run).
+    """
+    end = len(blob)
+    j = start
+    pending: list = []
+    zc = 0.0
+    seen = 0  # store fields already parsed (bit 0 pos, bit 1 neg)
+    while j < end:
+        tag = blob[j]
+        if tag == 0x12 or tag == 0x1A:  # positiveValues / negativeValues
+            bit = 1 if tag == 0x12 else 2
+            if seen & bit or j + 1 >= end:
+                return None
+            seen |= bit
+            # Inlined varints (canonical store bodies are `0x12 <len>
+            # <payload> [0x18 <zigzag off>]`; anything else falls back).
+            b = blob[j + 1]
+            if b < 0x80:
+                ln = b
+                j += 2
+            else:
+                ln, j = _read_varint(blob, j + 1)
+            end_body = j + ln
+            if end_body > end:
+                return None
+            if ln == 0:  # empty store submessage
+                continue
+            if blob[j] != 0x12 or j + 1 >= end_body:
+                return None
+            b = blob[j + 1]
+            if b < 0x80:
+                pl = b
+                p0 = j + 2
+            else:
+                pl, p0 = _read_varint(blob, j + 1)
+            pend = p0 + pl
+            if pend > end_body or pl & 7:
+                return None
+            key_off = 0
+            if pend < end_body:
+                if blob[pend] != 0x18 or pend + 1 >= end_body:
+                    return None
+                z, nxt = _read_varint(blob, pend + 1)
+                key_off = (z >> 1) ^ -(z & 1)
+                if nxt != end_body:
+                    return None
+            # Trim the run's trailing all-zero doubles (the host store's
+            # chunk padding): shorter groups, no out-of-window zero
+            # overhang, and the group block shrinks to the real mass.
+            # rstrip is C-speed; the kept view slices the ORIGINAL blob
+            # (zero copy) at the 8-byte-rounded cut, so a double with any
+            # nonzero byte survives whole.
+            stripped = blob[p0:pend].rstrip(b"\x00")
+            t_len = (len(stripped) + 7) >> 3
+            if t_len:
+                pending.append(
+                    (
+                        (tag == 0x1A, t_len),
+                        (
+                            i,
+                            key_off - base,
+                            memoryview(blob)[p0 : p0 + 8 * t_len],
+                        ),
+                    )
+                )
+            j = end_body
+        elif tag == 0x21:  # zeroCount double
+            if j + 9 > end:
+                return None
+            zc = struct.unpack_from("<d", blob, j + 1)[0]
+            j += 9
+        else:
+            return None
+    return pending, zc
+
+
 def bytes_to_state(
     spec: SketchSpec,
     blobs: Sequence[bytes],
@@ -393,111 +479,34 @@ def bytes_to_state(
         and not assume_native_linear
     )
     base = spec.key_offset
-    groups = dec.groups
     zeros: list = []  # (stream, zeroCount) -- vector-assigned at the end
-    unpack_d = struct.unpack_from
     for i, blob in enumerate(blobs):
-        if not (fast_ok and blob.startswith(expected_mapping)):
+        parsed = None
+        if fast_ok and blob.startswith(expected_mapping):
+            # IndexError backstop: a malformed varint whose continuation
+            # bits run off the blob end must land on the careful path
+            # (DecodeError), not escape as a bare IndexError.
+            try:
+                parsed = _parse_canonical(blob, mlen, i, base)
+            except IndexError:
+                parsed = None
+        if parsed is None:
             dec.careful_message(
                 i, pb.DDSketch.FromString(blob), assume_native_linear
             )
             continue
-        end = len(blob)
-        ok = True
-        j = mlen
-        pending: list = []  # this stream's runs, committed only when ok
-        zc = 0.0
-        seen = 0  # store fields already parsed (bit 0 pos, bit 1 neg)
-        while j < end:
-            tag = blob[j]
-            if tag == 0x12 or tag == 0x1A:  # positiveValues/negativeValues
-                # A repeated store field is legal protobuf (the parser
-                # merges occurrences); the group scatter assumes one run
-                # per (stream, store), so duplicates take the careful path.
-                bit = 1 if tag == 0x12 else 2
-                if seen & bit:
-                    ok = False
-                    break
-                seen |= bit
-                # Inlined varints (canonical store bodies are `0x12 <len>
-                # <payload> [0x18 <zigzag off>]`; anything else falls back).
-                b = blob[j + 1]
-                if b < 0x80:
-                    ln = b
-                    j += 2
-                else:
-                    ln, j = _read_varint(blob, j + 1)
-                end_body = j + ln
-                if ln == 0:  # empty store submessage
-                    continue
-                if blob[j] != 0x12:
-                    ok = False
-                    break
-                b = blob[j + 1]
-                if b < 0x80:
-                    pl = b
-                    p0 = j + 2
-                else:
-                    pl, p0 = _read_varint(blob, j + 1)
-                pend = p0 + pl
-                key_off = 0
-                if pend < end_body:
-                    if blob[pend] != 0x18:
-                        ok = False
-                        break
-                    z, nxt = _read_varint(blob, pend + 1)
-                    key_off = (z >> 1) ^ -(z & 1)
-                    if nxt != end_body:
-                        ok = False
-                        break
-                elif pend != end_body:
-                    ok = False
-                    break
-                if pl & 7:
-                    ok = False
-                    break
-                # Trim the run's trailing all-zero doubles (the host
-                # store's chunk padding): shorter groups, no out-of-window
-                # zero overhang, and the group block shrinks to the real
-                # mass.  rstrip is C-speed; the kept view slices the
-                # ORIGINAL blob (zero copy) at the 8-byte-rounded cut, so
-                # a double with any nonzero byte survives whole.
-                stripped = blob[p0:pend].rstrip(b"\x00")
-                t_len = (len(stripped) + 7) >> 3
-                if t_len:
-                    pending.append(
-                        (
-                            (tag == 0x1A, t_len),
-                            (
-                                i,
-                                key_off - base,
-                                memoryview(blob)[p0 : p0 + 8 * t_len],
-                            ),
-                        )
-                    )
-                j = end_body
-            elif tag == 0x21:  # zeroCount double
-                zc = unpack_d("<d", blob, j + 1)[0]
-                j += 9
-            else:
-                ok = False
-                break
-        if ok:
-            for key, entry in pending:
-                g = groups.get(key)
-                if g is None:
-                    g = groups[key] = []
-                g.append(entry)
-                dec.pending_bytes += key[1] << 3
-            if zc:
-                zeros.append((i, zc))
-            if dec.pending_bytes >= dec._FLUSH_BYTES:
-                dec.flush_groups()
-                groups = dec.groups
-        else:
-            dec.careful_message(
-                i, pb.DDSketch.FromString(blob), assume_native_linear
-            )
+        pending, zc = parsed
+        groups = dec.groups
+        for key, entry in pending:
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = []
+            g.append(entry)
+            dec.pending_bytes += key[1] << 3
+        if zc:
+            zeros.append((i, zc))
+        if dec.pending_bytes >= dec._FLUSH_BYTES:
+            dec.flush_groups()
     if zeros:
         zi = np.fromiter((z[0] for z in zeros), np.int64, len(zeros))
         zv = np.fromiter((z[1] for z in zeros), np.float64, len(zeros))

@@ -184,6 +184,20 @@ def test_decode_duplicate_store_fields_merge():
     assert float(np.asarray(via_wire.count)[0]) == pytest.approx(12.0)
 
 
+def test_decode_truncated_blob_raises():
+    """A truncated canonical blob must raise (protobuf DecodeError via the
+    careful path), never silently drop the clipped run's mass (review r5)."""
+    from google.protobuf.message import DecodeError
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 4, seed=21, with_empty=False)
+    blobs = batched_to_bytes(spec, st)
+    for cut in (1, 8, 200, 516, 700):
+        bad = blobs[0][:-cut] if cut < len(blobs[0]) else b"\x12"
+        with pytest.raises((DecodeError, ValueError)):
+            batched_from_bytes(spec, [bad])
+
+
 def test_decode_refuses_foreign_linear():
     from tests.test_wire import ddsketch_bytes, index_mapping_bytes, store_bytes
 
