@@ -1051,15 +1051,17 @@ def choose_query_engine(window_plan, tile_plan) -> str:
     ``window_plan`` = (lo_w, n_w, w_tiles, with_neg) from
     :func:`plan_state_window`; ``tile_plan`` = (k_tiles, with_neg) from
     :func:`plan_tile_query` (or None when ineligible).  Measured basis
-    (131k x 512 v5e shard, re-measured r5 after the decode cut): a
-    single-tile occupied window is the windowed kernel's best case (one
-    wide DMA, no list machinery; 0.15 ms sustained vs the tile kernel's
-    1.35 on tight telemetry); wider spans go to the tile-list kernel when
-    its per-block needed-tile bound is at or below the span (equal-bytes
-    ties now favor tiles: at the 4-tile positive-only window the tile
-    kernel measures 0.99 ms sustained vs windowed 1.36 -- the r4 basis
-    predated the cheaper shared decode) or when the negative store
-    participates (the windowed kernel then scans BOTH spans).
+    (131k x 512 v5e shard; tie-break re-verified r5 DEVICE-CLOCKED after
+    the decode cut -- a sustained-number reading briefly suggested tiles
+    should take equal-byte ties, but the per-call device track says
+    otherwise: windowed 1.41 ms vs tiles 1.67 ms at the 4-tile
+    positive-only window; sustained readings of that shape swung
+    0.99-1.52 ms between runs): a single-tile occupied window is the
+    windowed kernel's best case (one wide DMA, no list machinery); wider
+    spans go to the tile-list kernel when its per-block needed-tile bound
+    strictly beats the span (bytes) or when the negative store
+    participates (the windowed kernel then scans BOTH spans; the tile
+    fold's per-tile compute is far cheaper).
     """
     if tile_plan is None:
         return "windowed"
@@ -1070,7 +1072,7 @@ def choose_query_engine(window_plan, tile_plan) -> str:
         return "windowed"
     k_eff = k_tiles * (2 if with_neg_t else 1)
     win_eff = span * (2 if with_neg_w else 1)
-    return "tiles" if (with_neg_t or k_eff <= win_eff) else "windowed"
+    return "tiles" if (with_neg_t or k_eff < win_eff) else "windowed"
 
 
 def _tile_targets(spec: SketchSpec, state: SketchState, qs: jax.Array):
@@ -1334,85 +1336,96 @@ def _tiles_kernel(
 
     @pl.when(j == pl.num_programs(1) - 1)
     def _():
-        local = _cumsum_tile(acc[:])  # [Q*bn, 128]: ONE scan for all q
-        # Branch-specific compare per q: pos walks lower=True (<=), neg
-        # lower=False (strict <) -- identical to batched.quantile.  The
-        # compares are cheap full-lane VPU ops; their [bn, 128] results
-        # sublane-concat back into one slab (lane offsets agree -- Mosaic
-        # rejects sublane concat of lane-offset [bn, 1] slices) so the
-        # rank count is ONE mask-matvec for every quantile.  Selects run
-        # in bf16, not i1 (no Mosaic select on boolean vectors).
-        parts = []
-        for q in range(q_total):
-            lq = jax.lax.slice_in_dim(local, q * bn, (q + 1) * bn, axis=0)
-            tq = pk[:, q : q + 1]
-            isn = pk[:, q_total + q : q_total + q + 1] >= jnp.float32(t)
-            parts.append(
-                jnp.where(
-                    isn,
-                    (lq < tq).astype(jnp.bfloat16),
-                    (lq <= tq).astype(jnp.bfloat16),
-                )
+        out_ref[:] = _count_and_decode(
+            acc[:], pk, spec=spec, q_total=q_total, bn=bn, with_neg=with_neg
+        )
+
+
+def _count_and_decode(slab, pk, *, spec, q_total, bn, with_neg):
+    """The tile-list kernel's accumulator-slab finalization: ONE 3-term
+    scan + ONE mask-matvec for every quantile, then the in-kernel
+    [bn, Q]-batched decode -> final values.  (Factored out of
+    ``_tiles_kernel`` during the r5 span-fold experiment -- that kernel
+    measured a wash and was removed, DESIGN.md 3c-r5 -- and kept
+    separate: the finalization is the single largest compute block and
+    reads as a unit.)
+
+    Branch-specific compare per q: pos walks lower=True (<=), neg
+    lower=False (strict <) -- identical to batched.quantile.  The
+    compares are cheap full-lane VPU ops; their [bn, 128] results
+    sublane-concat back into one slab (lane offsets agree -- Mosaic
+    rejects sublane concat of lane-offset [bn, 1] slices) so the rank
+    count is ONE mask-matvec for every quantile.  Selects run in bf16,
+    not i1 (no Mosaic select on boolean vectors).  The decode emits
+    FINAL values (zero branch, sign, NaN validity included) so no
+    [N, Q]-shaped XLA work exists after the pallas barrier: alternatives
+    measured and rejected at 131k streams -- decode in XLA at [N, Q]
+    (chain left unfused with transposed-layout copies: +3 ms),
+    flatten-to-1-D (physical relayout of the lane-padded stripe: +3 ms),
+    per-q in-kernel decode (Q chains of [bn, 1]-shaped ops: +2.7 ms).
+    """
+    t = spec.n_tiles
+    local = _cumsum_tile(slab)  # [Q*bn, 128]: ONE scan for all q
+    parts = []
+    for q in range(q_total):
+        lq = jax.lax.slice_in_dim(local, q * bn, (q + 1) * bn, axis=0)
+        tq = pk[:, q : q + 1]
+        isn = pk[:, q_total + q : q_total + q + 1] >= jnp.float32(t)
+        parts.append(
+            jnp.where(
+                isn,
+                (lq < tq).astype(jnp.bfloat16),
+                (lq <= tq).astype(jnp.bfloat16),
             )
-        mask = jnp.concatenate(parts, axis=0)  # [Q*bn, 128]
-        ones8 = jnp.ones((LO, 8), jnp.bfloat16)
-        cnt = jax.lax.dot_general(
-            mask, ones8, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )[:, :1]  # [Q*bn, 1]
-        idx_cols = []
-        for q in range(q_total):
-            ut = pk[:, q_total + q : q_total + q + 1]
-            isn = ut >= jnp.float32(t)
-            tile = ut - jnp.where(isn, jnp.float32(t), 0.0)
-            cq = jax.lax.slice_in_dim(cnt, q * bn, (q + 1) * bn, axis=0)
-            idx_cols.append(tile * 128.0 + cq)
-        # Decode in-kernel, ONE [bn, Q]-batched value_array call for all
-        # quantiles, emitting FINAL values (zero branch, sign, NaN
-        # validity included) -- so no [N, Q]-shaped XLA work exists after
-        # the pallas barrier at all.  Alternatives measured and rejected
-        # at 131k streams: decode in XLA at [N, Q] (chain left unfused
-        # with transposed-layout copies: +3 ms); flatten-to-1-D decode
-        # (the [N, Q] -> [N*Q] reshape is a physical relayout of the
-        # lane-padded stripe: +3 ms); per-q in-kernel decode (Q chains of
-        # [bn, 1]-shaped ops: +2.7 ms).
-        idx = jnp.concatenate(idx_cols, axis=1)  # [bn, Q] f32-exact
-        ut = pk[:, q_total : 2 * q_total]
-        is_neg = ut >= jnp.float32(t)
-        zflag = pk[:, 2 * q_total : 3 * q_total]
-        nanflag = pk[:, 3 * q_total : 4 * q_total]
-        base = 4 * q_total
-        koff = pk[:, base : base + 1]
-        first_pos = pk[:, base + 1 : base + 2]
-        last_pos = jnp.maximum(pk[:, base + 2 : base + 3], first_pos)
-        if with_neg:
-            # ONE decode chain for both stores (r5: the [bn, Q]-shaped
-            # lane-padded value_array chain measured 0.85 ms of the
-            # worst case's 2.30 -- the largest single compute term; the
-            # pos and neg decodes differ only in clip bounds and sign,
-            # so branch-select the bounds BEFORE the chain and the sign
-            # after, halving it).
-            first_neg = pk[:, base + 3 : base + 4]
-            last_neg = jnp.maximum(pk[:, base + 4 : base + 5], first_neg)
-            first = jnp.where(is_neg, first_neg, first_pos)
-            last = jnp.where(is_neg, last_neg, last_pos)
-            sign = jnp.where(is_neg, jnp.float32(-1.0), jnp.float32(1.0))
-            dec = sign * spec.mapping.value_array(
-                jnp.clip(idx, first, last) + koff
-            )
-            # zflag and is_neg are mutually exclusive (the zero branch is
-            # "not negative and rank below zero_count"), so one select
-            # recovers the three-way branch.
-            val = jnp.where(zflag > 0.5, 0.0, dec)
-        else:
-            # neg_total == 0 everywhere: any negative-branch rank belongs
-            # to an empty stream, NaN'd below -- the windowed kernel's
-            # with_neg=False contract.
-            val_pos = spec.mapping.value_array(
-                jnp.clip(idx, first_pos, last_pos) + koff
-            )
-            val = jnp.where(zflag > 0.5, 0.0, val_pos)
-        out_ref[:] = jnp.where(nanflag > 0.5, jnp.float32(jnp.nan), val)
+        )
+    mask = jnp.concatenate(parts, axis=0)  # [Q*bn, 128]
+    ones8 = jnp.ones((LO, 8), jnp.bfloat16)
+    cnt = jax.lax.dot_general(
+        mask, ones8, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )[:, :1]  # [Q*bn, 1]
+    idx_cols = []
+    for q in range(q_total):
+        ut = pk[:, q_total + q : q_total + q + 1]
+        isn = ut >= jnp.float32(t)
+        tile = ut - jnp.where(isn, jnp.float32(t), 0.0)
+        cq = jax.lax.slice_in_dim(cnt, q * bn, (q + 1) * bn, axis=0)
+        idx_cols.append(tile * 128.0 + cq)
+    idx = jnp.concatenate(idx_cols, axis=1)  # [bn, Q] f32-exact
+    ut = pk[:, q_total : 2 * q_total]
+    is_neg = ut >= jnp.float32(t)
+    zflag = pk[:, 2 * q_total : 3 * q_total]
+    nanflag = pk[:, 3 * q_total : 4 * q_total]
+    base = 4 * q_total
+    koff = pk[:, base : base + 1]
+    first_pos = pk[:, base + 1 : base + 2]
+    last_pos = jnp.maximum(pk[:, base + 2 : base + 3], first_pos)
+    if with_neg:
+        # ONE decode chain for both stores (r5: the [bn, Q]-shaped
+        # lane-padded value_array chain measured 0.85 ms of the worst
+        # case's 2.30 -- the largest single compute term; the pos and neg
+        # decodes differ only in clip bounds and sign, so branch-select
+        # the bounds BEFORE the chain and the sign after, halving it).
+        first_neg = pk[:, base + 3 : base + 4]
+        last_neg = jnp.maximum(pk[:, base + 4 : base + 5], first_neg)
+        first = jnp.where(is_neg, first_neg, first_pos)
+        last = jnp.where(is_neg, last_neg, last_pos)
+        sign = jnp.where(is_neg, jnp.float32(-1.0), jnp.float32(1.0))
+        dec = sign * spec.mapping.value_array(
+            jnp.clip(idx, first, last) + koff
+        )
+        # zflag and is_neg are mutually exclusive (the zero branch is
+        # "not negative and rank below zero_count"), so one select
+        # recovers the three-way branch.
+        val = jnp.where(zflag > 0.5, 0.0, dec)
+    else:
+        # neg_total == 0 everywhere: any negative-branch rank belongs to
+        # an empty stream, NaN'd below -- the with_neg=False contract.
+        val_pos = spec.mapping.value_array(
+            jnp.clip(idx, first_pos, last_pos) + koff
+        )
+        val = jnp.where(zflag > 0.5, 0.0, val_pos)
+    return jnp.where(nanflag > 0.5, jnp.float32(jnp.nan), val)
 
 
 def fused_quantile_tiles(

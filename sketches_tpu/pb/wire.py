@@ -367,12 +367,14 @@ class _Decoder:
 def _parse_canonical(blob: bytes, start: int, i: int, base: int):
     """Walk one canonical blob past its mapping prefix.
 
-    Returns ``(pending, zero_count)`` -- ``pending`` holds
-    ``((is_neg, trimmed_len), (stream, window_start, payload view))`` per
-    store run -- or ``None`` for ANY non-canonical shape: unknown fields,
-    repeated store fields (legal protobuf, but the group scatter assumes
-    one run per (stream, store)), and declared lengths that leave the
-    blob (review r5: a truncated blob must reach the careful path, whose
+    Returns ``(pending, zero_count, store_positions, zc_pos)`` --
+    ``pending`` holds ``((is_neg, trimmed_len), (stream, window_start,
+    payload view))`` per store run; ``store_positions`` /``zc_pos`` are
+    the absolute byte positions a :class:`_Template` needs -- or ``None``
+    for ANY non-canonical shape: unknown fields, repeated store fields
+    (legal protobuf, but the group scatter assumes one run per
+    (stream, store)), and declared lengths that leave the blob (review
+    r5: a truncated blob must reach the careful path, whose
     ``FromString`` raises DecodeError, never be silently slice-clamped
     into a shorter run).
     """
@@ -380,6 +382,8 @@ def _parse_canonical(blob: bytes, start: int, i: int, base: int):
     j = start
     pending: list = []
     zc = 0.0
+    zc_pos = -1
+    positions: list = []
     seen = 0  # store fields already parsed (bit 0 pos, bit 1 neg)
     while j < end:
         tag = blob[j]
@@ -413,6 +417,7 @@ def _parse_canonical(blob: bytes, start: int, i: int, base: int):
             if pend > end_body or pl & 7:
                 return None
             key_off = 0
+            off_a = off_b = -1
             if pend < end_body:
                 if blob[pend] != 0x18 or pend + 1 >= end_body:
                     return None
@@ -420,6 +425,8 @@ def _parse_canonical(blob: bytes, start: int, i: int, base: int):
                 key_off = (z >> 1) ^ -(z & 1)
                 if nxt != end_body:
                     return None
+                off_a, off_b = pend + 1, nxt
+            positions.append((tag == 0x1A, p0, pend, off_a, off_b))
             # Trim the run's trailing all-zero doubles (the host store's
             # chunk padding): shorter groups, no out-of-window zero
             # overhang, and the group block shrinks to the real mass.
@@ -444,10 +451,84 @@ def _parse_canonical(blob: bytes, start: int, i: int, base: int):
             if j + 9 > end:
                 return None
             zc = struct.unpack_from("<d", blob, j + 1)[0]
+            zc_pos = j
             j += 9
         else:
             return None
-    return pending, zc
+    return pending, zc, positions, zc_pos
+
+
+class _Template:
+    """Structural fast path for same-shaped canonical blobs.
+
+    Bulk batches are highly homogeneous: most blobs share byte-identical
+    STRUCTURE (field tags, length varints, offset-varint widths) and
+    differ only in the payload doubles, the offset-varint values, and the
+    zeroCount value.  A template memorizes one fully-parsed blob's
+    structural byte ranges; a candidate of the same length whose
+    structural bytes match byte-for-byte skips the field walk (one memcmp
+    per range + per-store varint/rstrip).  Any mismatch -- including a
+    same-length blob with compensating structural differences -- simply
+    misses and takes the full walker, so the template is a pure
+    optimization with no acceptance risk.
+    """
+
+    __slots__ = ("struct_slices", "stores", "zc_pos")
+
+    def __init__(self, blob: bytes, start: int, stores, zc_pos: int):
+        self.stores = stores
+        self.zc_pos = zc_pos
+        value_ranges = []  # byte ranges whose CONTENT may differ per blob
+        for (_, p0, pend, off_a, off_b) in stores:
+            value_ranges.append((p0, pend))
+            if off_a >= 0:
+                value_ranges.append((off_a, off_b))
+        if zc_pos >= 0:
+            value_ranges.append((zc_pos + 1, zc_pos + 9))
+        value_ranges.sort()
+        slices = []
+        prev = start
+        for a, b in value_ranges:
+            if a > prev:
+                slices.append((prev, blob[prev:a]))
+            prev = b
+        if prev < len(blob):
+            slices.append((prev, blob[prev:]))
+        self.struct_slices = slices
+
+    def extract(self, blob: bytes, i: int, base: int):
+        """(pending, zc) for a structurally matching blob, else None."""
+        for a, ref in self.struct_slices:
+            if blob[a : a + len(ref)] != ref:
+                return None
+        pending = []
+        mv = memoryview(blob)
+        for (is_neg, p0, pend, off_a, off_b) in self.stores:
+            key_off = 0
+            if off_a >= 0:
+                # Same offset-varint WIDTH is structural; the value is
+                # free.  The continuation pattern must terminate exactly
+                # at off_b or the structure differs after all.
+                if blob[off_b - 1] & 0x80:
+                    return None
+                for k in range(off_a, off_b - 1):
+                    if not blob[k] & 0x80:
+                        return None
+                z, _ = _read_varint(blob, off_a)
+                key_off = (z >> 1) ^ -(z & 1)
+            stripped = blob[p0:pend].rstrip(b"\x00")
+            t_len = (len(stripped) + 7) >> 3
+            if t_len:
+                pending.append(
+                    (
+                        (is_neg, t_len),
+                        (i, key_off - base, mv[p0 : p0 + 8 * t_len]),
+                    )
+                )
+        zc = 0.0
+        if self.zc_pos >= 0:
+            zc = struct.unpack_from("<d", blob, self.zc_pos + 1)[0]
+        return pending, zc
 
 
 def bytes_to_state(
@@ -480,16 +561,28 @@ def bytes_to_state(
     )
     base = spec.key_offset
     zeros: list = []  # (stream, zeroCount) -- vector-assigned at the end
+    templates: dict = {}  # blob length -> _Template
     for i, blob in enumerate(blobs):
         parsed = None
         if fast_ok and blob.startswith(expected_mapping):
-            # IndexError backstop: a malformed varint whose continuation
-            # bits run off the blob end must land on the careful path
-            # (DecodeError), not escape as a bare IndexError.
-            try:
-                parsed = _parse_canonical(blob, mlen, i, base)
-            except IndexError:
-                parsed = None
+            t = templates.get(len(blob))
+            if t is not None:
+                parsed = t.extract(blob, i, base)
+            if parsed is None:
+                # IndexError backstop: a malformed varint whose
+                # continuation bits run off the blob end must land on the
+                # careful path (DecodeError), not escape as IndexError.
+                try:
+                    full = _parse_canonical(blob, mlen, i, base)
+                except IndexError:
+                    full = None
+                if full is not None:
+                    pending_f, zc_f, positions, zc_pos = full
+                    parsed = (pending_f, zc_f)
+                    if t is None:
+                        templates[len(blob)] = _Template(
+                            blob, mlen, positions, zc_pos
+                        )
         if parsed is None:
             dec.careful_message(
                 i, pb.DDSketch.FromString(blob), assume_native_linear

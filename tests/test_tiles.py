@@ -272,8 +272,9 @@ def test_choose_query_engine_policy():
     assert choose((0, 1, 4, True), (4, True)) == "tiles"
     # Byte win: k_eff < win_eff.
     assert choose((0, 3, 1, False), (1, False)) == "tiles"
-    # Equal bytes -> tiles since r5 (measured 0.99 vs 1.36 ms at the
-    # 4-tile positive window after the decode cut).
-    assert choose((0, 1, 4, False), (4, False)) == "tiles"
+    # Equal bytes, no neg -> windowed (device-clocked r5: 1.41 vs 1.67 ms
+    # at the 4-tile positive window; a sustained reading briefly argued
+    # the other way but swung 0.99-1.52 ms between runs).
+    assert choose((0, 1, 4, False), (4, False)) == "windowed"
     # Window strictly narrower than the tile bound -> windowed.
     assert choose((0, 2, 1, False), (4, False)) == "windowed"

@@ -184,6 +184,70 @@ def test_decode_duplicate_store_fields_merge():
     assert float(np.asarray(via_wire.count)[0]) == pytest.approx(12.0)
 
 
+def test_template_fast_path_matches_full_parse():
+    """Homogeneous batches hit the structural template; the result must be
+    identical to the full walker's (same-length blobs with different
+    offsets exercise the value-byte freedom)."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    # Same run length (one 128-chunk), different window offsets per stream
+    # via per-stream scale: same blob LENGTH when offset varint widths
+    # agree, different offset values.
+    rng = np.random.RandomState(31)
+    v = (rng.lognormal(0, 0.5, (64, 64)) * 2.0).astype(np.float32)
+    st = add(spec, init(spec, 64), jnp.asarray(v))
+    blobs = batched_to_bytes(spec, st)
+    from collections import Counter
+
+    lens = Counter(len(b) for b in blobs)
+    assert max(lens.values()) > 1, "no same-length blobs; test impotent"
+    back = batched_from_bytes(spec, blobs)
+    via_host = from_host_sketches(
+        spec,
+        [DDSketchProto.from_proto(pb.DDSketch.FromString(b)) for b in blobs],
+    )
+    _assert_states_equal(via_host, back)
+
+
+def test_template_rejects_same_length_different_structure():
+    """Two SAME-LENGTH canonical blobs whose structure differs must both
+    decode correctly -- the template may only miss, never misread.
+
+    Constructed to collide on the length key the template cache uses:
+    A = 16-double run + 1-byte offset varint + zeroCount field (9 bytes);
+    B = 17-double run + 2-byte offset varint, no zeroCount.  Byte
+    arithmetic: A's extras (2 + 9) == B's extras (8 + 3).
+    """
+    from tests.test_wire import (
+        ddsketch_bytes,
+        index_mapping_bytes,
+        store_bytes,
+    )
+
+    GAMMA = (1 + 0.02) / (1 - 0.02)
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    mapping = index_mapping_bytes(GAMMA, 0)
+    blob_a = ddsketch_bytes(
+        mapping,
+        pos=store_bytes(contiguous=[float(k + 1) for k in range(16)], offset=5),
+        zero_count=4.0,
+    )
+    blob_b = ddsketch_bytes(
+        mapping,
+        pos=store_bytes(contiguous=[float(k + 1) for k in range(17)], offset=-70),
+    )
+    assert len(blob_a) == len(blob_b), (len(blob_a), len(blob_b))
+    for order in ((blob_a, blob_b), (blob_b, blob_a)):
+        back = batched_from_bytes(spec, list(order))
+        via_host = from_host_sketches(
+            spec,
+            [
+                DDSketchProto.from_proto(pb.DDSketch.FromString(x))
+                for x in order
+            ],
+        )
+        _assert_states_equal(via_host, back)
+
+
 def test_decode_truncated_blob_raises():
     """A truncated canonical blob must raise (protobuf DecodeError via the
     careful path), never silently drop the clipped run's mass (review r5)."""
