@@ -17,6 +17,7 @@ _EXAMPLES = os.path.join(
         "latency_monitoring.py",
         "distributed_mesh.py",
         "heterogeneous_fleet.py",
+        "wire_interop.py",
     ],
 )
 def test_example_runs_clean(script):
