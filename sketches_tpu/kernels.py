@@ -1286,12 +1286,9 @@ def _tiles_kernel(
     Per cell: fold the fetched 128-bin tile into each q's accumulator slab
     where that (stream, q) targets this tile -- two VPU ops per q, no
     matmuls.  The accumulator stacks the Q per-quantile rows on SUBLANES
-    (``[Q*bn, 128]``), so the final cell runs ONE 3-term exact cumsum and
-    ONE mask-matvec for every quantile at once (per-q [bn, 1]-shaped work
-    wastes 127/128 lanes per op -- measured 6x the whole kernel).  The
-    kernel emits raw within-window indices; the bucket decode, bounds
-    clipping, and branch select run in the caller's fused XLA epilogue,
-    where they vectorize across all N streams.
+    (``[Q*bn, 128]``), so the final cell runs ONE 3-term exact cumsum for
+    every quantile at once, then per-q mask-matvec count COLUMNS and the
+    in-kernel [bn, Q]-batched decode (``_count_and_decode``).
     """
     if with_neg:
         (lp_ref, ln_ref, packed_ref, bp_ref, bn_ref, out_ref, acc) = refs
@@ -1343,10 +1340,10 @@ def _tiles_kernel(
 
 def _count_and_decode(slab, pk, *, spec, q_total, bn, with_neg):
     """The tile-list kernel's accumulator-slab finalization: ONE 3-term
-    scan + ONE mask-matvec for every quantile, then the in-kernel
-    [bn, Q]-batched decode -> final values.  (Factored out of
-    ``_tiles_kernel`` during the r5 span-fold experiment -- that kernel
-    measured a wash and was removed, DESIGN.md 3c-r5 -- and kept
+    scan for every quantile at once, per-q mask-matvec count columns,
+    then the in-kernel [bn, Q]-batched decode -> final values.  (Factored
+    out of ``_tiles_kernel`` during the r5 span-fold experiment -- that
+    kernel measured a wash and was removed, DESIGN.md 3c-r5 -- and kept
     separate: the finalization is the single largest compute block and
     reads as a unit.)
 
@@ -1378,22 +1375,27 @@ def _count_and_decode(slab, pk, *, spec, q_total, bn, with_neg):
                 (lq <= tq).astype(jnp.bfloat16),
             )
         )
-    mask = jnp.concatenate(parts, axis=0)  # [Q*bn, 128]
+    # Per-q mask-matvecs emitting [bn, 1] count COLUMNS, lane-concatenated
+    # to [bn, Q], with the tile math done once at [bn, Q] width -- instead
+    # of one matvec over a sublane-concatenated [Q*bn, 128] mask plus a
+    # per-q chain of [bn, 1] slices (ut/isn/tile/cnt-slice/idx, 5 narrow
+    # ops x Q, each costing 128 vregs regardless of width).  Measured
+    # r5 on the worst-case shard: 1.82 vs 1.97 ms device-clocked p50=p99
+    # -- the extra Q-1 matmul invocations are far cheaper than the
+    # narrow-op chains and the big sublane concat they replace.
     ones8 = jnp.ones((LO, 8), jnp.bfloat16)
-    cnt = jax.lax.dot_general(
-        mask, ones8, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )[:, :1]  # [Q*bn, 1]
-    idx_cols = []
-    for q in range(q_total):
-        ut = pk[:, q_total + q : q_total + q + 1]
-        isn = ut >= jnp.float32(t)
-        tile = ut - jnp.where(isn, jnp.float32(t), 0.0)
-        cq = jax.lax.slice_in_dim(cnt, q * bn, (q + 1) * bn, axis=0)
-        idx_cols.append(tile * 128.0 + cq)
-    idx = jnp.concatenate(idx_cols, axis=1)  # [bn, Q] f32-exact
-    ut = pk[:, q_total : 2 * q_total]
-    is_neg = ut >= jnp.float32(t)
+    cnt_cols = [
+        jax.lax.dot_general(
+            m, ones8, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )[:, :1]
+        for m in parts
+    ]
+    cnt = jnp.concatenate(cnt_cols, axis=1)  # [bn, Q]
+    ut_all = pk[:, q_total : 2 * q_total]
+    is_neg = ut_all >= jnp.float32(t)
+    tile_all = ut_all - jnp.where(is_neg, jnp.float32(t), jnp.float32(0.0))
+    idx = tile_all * 128.0 + cnt  # [bn, Q] f32-exact
     zflag = pk[:, 2 * q_total : 3 * q_total]
     nanflag = pk[:, 3 * q_total : 4 * q_total]
     base = 4 * q_total
