@@ -262,6 +262,61 @@ def test_decode_truncated_blob_raises():
             batched_from_bytes(spec, [bad])
 
 
+def test_decode_differential_fuzz_mutations():
+    """Differential fuzz: for randomly mutated canonical blobs, the bulk
+    decoder must agree with the protobuf reference path exactly -- raise
+    where ``FromString`` raises, and decode to the identical state where
+    it parses (flipped payload bytes, truncations, corrupted varints; no
+    bare IndexError may escape)."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 8, seed=41, with_empty=False)
+    blobs = batched_to_bytes(spec, st)
+    rng = np.random.RandomState(42)
+    checked_ok = checked_raise = 0
+    for trial in range(120):
+        blob = bytearray(blobs[trial % len(blobs)])
+        op = trial % 3
+        if op == 0:  # flip a random byte
+            i = rng.randint(len(blob))
+            blob[i] ^= 1 << rng.randint(8)
+        elif op == 1:  # truncate
+            blob = blob[: rng.randint(1, len(blob))]
+        else:  # corrupt a varint-ish region near a structure boundary
+            i = rng.randint(min(32, len(blob)))
+            blob[i] = 0x80 | blob[i]
+        blob = bytes(blob)
+        try:
+            msg = pb.DDSketch.FromString(blob)
+            ref_err = None
+        except Exception as e:
+            msg, ref_err = None, e
+        if ref_err is not None:
+            with pytest.raises(Exception) as exc:
+                batched_from_bytes(spec, [blob])
+            assert not isinstance(exc.value, IndexError), blob.hex()
+            checked_raise += 1
+            continue
+        # Parseable bytes: the bulk decode must equal the object-bridge
+        # decode (mapping gates may still refuse -- then both paths must).
+        try:
+            via_host = from_host_sketches(
+                spec, [DDSketchProto.from_proto(msg)]
+            )
+            host_err = None
+        except Exception as e:
+            via_host, host_err = None, e
+        if host_err is not None:
+            with pytest.raises(type(host_err)):
+                batched_from_bytes(spec, [blob])
+            checked_raise += 1
+            continue
+        via_wire = batched_from_bytes(spec, [blob])
+        _assert_states_equal(via_host, via_wire)
+        checked_ok += 1
+    # The fuzz must exercise both outcomes to mean anything.
+    assert checked_ok > 10 and checked_raise > 10, (checked_ok, checked_raise)
+
+
 def test_decode_refuses_foreign_linear():
     from tests.test_wire import ddsketch_bytes, index_mapping_bytes, store_bytes
 
