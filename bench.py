@@ -217,6 +217,66 @@ def _tiles_query_fn(spec, state, qs):
     return q_fn, {"k_tiles": k_tiles, "with_neg": with_neg}
 
 
+def _overlap_query_fn(spec, state, qs):
+    """(query_fn, plan_dict) for the manually double-buffered overlap
+    engine -- same eligibility and plan as the tile engine (it IS the
+    tile walk with explicit DMA scheduling), or (None, None)."""
+    from sketches_tpu import kernels
+
+    if spec.bins_integer or not kernels.tile_query_eligible(
+        spec, int(qs.shape[0]), (0, 2, 1, False)
+    ):
+        return None, None
+    k_tiles, with_neg = kernels.plan_tile_query(spec, state, qs)
+
+    def q_fn(st_, qs_):
+        return kernels.fused_quantile_tiles_overlap(
+            spec, st_, qs_, k_tiles=k_tiles, with_neg=with_neg
+        )
+
+    return q_fn, {"k_tiles": k_tiles, "with_neg": with_neg}
+
+
+def bench_overlap_strip(spec, state, qs, iters: int = 64):
+    """P1-style stripped-variant decomposition of the overlap engine
+    (DESIGN.md 3c-r5 protocol, applied to 3c-r6's kernel): identical
+    grid, ring depth, and prefetch lists in every variant.
+
+    * ``p1_dma``  -- the explicit async copies + one plain add per tile
+      (the reads cannot be elided): the manual pipeline's DMA floor.
+    * ``p2_fold`` -- P1 + the full per-q mask-fold, finalization stubbed.
+    * ``p3_full`` -- the production kernel (count + decode included).
+
+    ``p3 - p2`` is the finalization the cross-block lookahead must hide;
+    ``p1`` vs the r5 auto-pipeline P1 (0.987 ms) shows what manual
+    scheduling does to the strided reads themselves.  Sustained
+    (floor-subtracted) seconds per call.
+    """
+    from sketches_tpu import kernels
+
+    k_tiles, with_neg = kernels.plan_tile_query(spec, state, qs)
+    out = {"k_tiles": k_tiles, "with_neg": with_neg}
+    import jax.numpy as jnp
+
+    for name, strip in (("p1_dma", "dma"), ("p2_fold", "fold"),
+                        ("p3_full", None)):
+        def q_fn(st_, qs_, strip=strip):
+            return kernels.fused_quantile_tiles_overlap(
+                spec, st_, qs_, k_tiles=k_tiles, with_neg=with_neg,
+                _strip=strip,
+            )
+
+        dt = fused_per_iter_s(
+            lambda i, acc, st_, qs_: acc
+            + q_fn(st_, qs_ * (1.0 - i.astype(jnp.float32) * 1e-4)).sum(),
+            jnp.float32(0.0),
+            iters=iters,
+            args=(state, qs),
+        )
+        out[name + "_s"] = round(dt, 6)
+    return out
+
+
 def device_query_pcts(q_fn, state, qs, iters: int = 100):
     """TRUE device-side p50/p99 of one query call, from profiler traces.
 
@@ -374,10 +434,15 @@ def _device_bench(
                 (plan["lo_wblock"], plan["n_wblocks"], plan["w_tiles"],
                  plan["with_neg"]),
                 (plan_tiles["k_tiles"], plan_tiles["with_neg"]),
+                overlap_ok=kernels.overlap_enabled(),
             )
             if pick == "tiles":
                 q_fn, plan = q_tiles, {**plan, **plan_tiles}
                 engine_pick = "tiles"
+            elif pick == "overlap":
+                q_over, _ = _overlap_query_fn(spec, state, qs)
+                q_fn, plan = q_over, {**plan, **plan_tiles}
+                engine_pick = "overlap"
     q_iters = max(16, 2 * fused_k)
 
     def _q_body(i, acc, st_, qs_):
@@ -608,9 +673,12 @@ def bench_shard_query(profile: bool):
         }
         if use_pallas:
             q_tiles, plan_tiles = _tiles_query_fn(spec, state, qs)
+            q_over = None
             if q_tiles is not None:
                 out["tiles_sustained_s"] = round(sustained(q_tiles), 6)
                 out["tile_plan"] = plan_tiles
+                q_over, _ = _overlap_query_fn(spec, state, qs)
+                out["overlap_sustained_s"] = round(sustained(q_over), 6)
                 # The facade's engine choice (ONE policy home).
                 from sketches_tpu import kernels
 
@@ -618,9 +686,12 @@ def bench_shard_query(profile: bool):
                     (plan_win["lo_wblock"], plan_win["n_wblocks"],
                      plan_win["w_tiles"], plan_win["with_neg"]),
                     (plan_tiles["k_tiles"], plan_tiles["with_neg"]),
+                    overlap_ok=kernels.overlap_enabled(),
                 )
                 out["facade_engine"] = pick
-                best_fn = q_tiles if pick == "tiles" else q_win
+                best_fn = {"tiles": q_tiles, "overlap": q_over}.get(
+                    pick, q_win
+                )
             else:
                 out["facade_engine"] = "windowed"
                 best_fn = q_win
@@ -629,10 +700,18 @@ def bench_shard_query(profile: bool):
             pcts = device_query_pcts(best_fn, state, qs)
             if pcts:
                 out["device_query"] = pcts
+            # The north star is judged on the overlap engine too, even
+            # where the policy picked otherwise: device-clocked per-call
+            # numbers are the only basis choose_query_engine may cite.
+            if q_over is not None and pick != "overlap":
+                pcts_o = device_query_pcts(q_over, state, qs)
+                if pcts_o:
+                    out["device_query_overlap"] = pcts_o
         out["query_sustained_s"] = out.get(
-            "tiles_sustained_s"
-            if out.get("facade_engine") == "tiles"
-            else "windowed_sustained_s",
+            {
+                "tiles": "tiles_sustained_s",
+                "overlap": "overlap_sustained_s",
+            }.get(out.get("facade_engine"), "windowed_sustained_s"),
             out["windowed_sustained_s"],
         )
         return state, out
@@ -641,6 +720,13 @@ def bench_shard_query(profile: bool):
         # Worst case: window-filling MIXED-SIGN data (every tile of both
         # stores occupied) -- the r3 verdict's robustness gap.
         state, worst = one_case(1.5, neg_frac=0.4)
+        if use_pallas:
+            # Stripped-variant decomposition of the overlap engine at the
+            # worst case (the 3c-r5 protocol): how much of the fold/count/
+            # decode compute the manual pipeline actually hides.
+            worst["overlap_strip"] = bench_overlap_strip(
+                spec, state, jnp.asarray(QS4, jnp.float32)
+            )
         # Window-filling positive-only.
         _, wide = one_case(1.5)
         # Mid occupancy: lognormal sigma=0.3 (~35x value spread) spans 3
@@ -699,8 +785,21 @@ def bench_jax_scalar(n: int = 1_000_000):
     for v in values:
         sk.add(v)
     sk.get_quantile_value(0.5)  # force the trailing settle + sync
+    add_per_s = round(n / (time.perf_counter() - t0), 1)
+    # Vectorized bulk add (VERDICT r5 item 7): same protocol -- timed over
+    # the adds plus the trailing settle/query -- same 1M values, fed as
+    # one array through add_many instead of the Python append loop.
+    arr = np.asarray(values)
+    sk2 = JaxDDSketch(0.01)
+    sk2.add_many(arr[:1024])  # warm the bulk path's jits/buffers
+    sk2.get_quantile_value(0.5)
+    sk2 = JaxDDSketch(0.01)
+    t0 = time.perf_counter()
+    sk2.add_many(arr)
+    sk2.get_quantile_value(0.5)
     return {
-        "add_per_s": round(n / (time.perf_counter() - t0), 1),
+        "add_per_s": add_per_s,
+        "add_many_per_s": round(n / (time.perf_counter() - t0), 1),
         "native_flush": native.available(),
     }
 
@@ -977,6 +1076,18 @@ def verify_on_device():
             )
             if not np.allclose(qt, qb, rtol=1e-4, equal_nan=True):
                 failures.append(f"{mapping}/w={weights is not None}/tiles")
+            # The overlap engine: manual async copies + cross-block
+            # lookahead need the REAL DMA/semaphore lowering proven, not
+            # just CI's interpreter semantics.
+            qo = np.asarray(
+                kernels.fused_quantile_tiles_overlap(
+                    spec, got, qs, k_tiles=k_tiles, with_neg=wn_t
+                )
+            )
+            if not np.array_equal(
+                np.nan_to_num(qo, nan=1.25), np.nan_to_num(qt, nan=1.25)
+            ):
+                failures.append(f"{mapping}/w={weights is not None}/overlap")
     return "pass" if not failures else "FAIL: " + ",".join(failures)
 
 
@@ -1029,6 +1140,48 @@ def bench_serde(n: int = 100_000):
         "to_proto_s": round(t3 - t2, 3),
         "from_proto_s": round(t4 - t3, 3),
         "bytes_total": sum(len(b) for b in blobs),
+    }
+
+
+def compact_summary(doc: dict, full_doc_name: str) -> dict:
+    """Headline metrics only, guaranteed small: the driver's stdout tail
+    capture truncates the full document mid-object (VERDICT r5 weak #4 --
+    ``BENCH_r05.json.parsed`` was null), so ``main`` prints this as its
+    FINAL stdout line and ships the full document to a local file.  Must
+    stay well under a kilobyte of JSON; everything here is a lookup into
+    the already-built ``doc``, total when a config was skipped."""
+    cfg = doc.get("configs", {})
+    c2s = cfg.get("c2s_shard_query_131k") or {}
+    worst = c2s.get("worst_mixed_sign") or {}
+    jax_scalar = cfg.get("c0_jax_scalar") or {}
+    serde = cfg.get("serde_bulk") or {}
+    return {
+        "metric": doc.get("metric"),
+        "value": doc.get("value"),
+        "unit": doc.get("unit"),
+        "vs_baseline": doc.get("vs_baseline"),
+        "ingest_1m_fused_per_s": (
+            cfg.get("c2_c4_1m_streams_cubic_collapsing") or {}
+        ).get("ingest_fused_per_s"),
+        "worst_query": {
+            k: worst.get(k)
+            for k in (
+                "facade_engine", "windowed_sustained_s",
+                "tiles_sustained_s", "overlap_sustained_s",
+                "device_query", "device_query_overlap", "overlap_strip",
+            )
+            if worst.get(k) is not None
+        },
+        "tight_device_query": (c2s.get("tight_telemetry") or {}).get(
+            "device_query"
+        ),
+        "jax_scalar_add_per_s": jax_scalar.get("add_per_s"),
+        "jax_scalar_add_many_per_s": jax_scalar.get("add_many_per_s"),
+        "serde_from_bytes_s": serde.get("from_bytes_s"),
+        "serde_to_bytes_s": serde.get("to_bytes_s"),
+        "verify": doc.get("verify_pallas_vs_xla_on_device"),
+        "device": doc.get("device"),
+        "full_doc": full_doc_name,
     }
 
 
@@ -1085,28 +1238,48 @@ def main():
     verify = verify_on_device()
 
     headline = c1["ingest_fused_per_s"]
+    jax_scalar = bench_jax_scalar()
+    serde = bench_serde()
+    doc = {
+        "metric": "batched_ingest_throughput",
+        "value": headline,
+        "unit": "values/s",
+        "vs_baseline": round(headline / host["add_per_s"], 2),
+        "configs": {
+            "c0_host_python": host,
+            "c0_host_native": bench_native(),
+            "c0_jax_scalar": jax_scalar,
+            "c1_10k_streams": c1,
+            "c2_c4_1m_streams_cubic_collapsing": c2c4,
+            "c2s_shard_query_131k": c2s,
+            "c3_distributed": c3,
+            "serde_bulk": serde,
+        },
+        "membw_read": membw,
+        "verify_pallas_vs_xla_on_device": verify,
+        "host_sync_floor_s": sync_floor_s,
+        "device": device,
+    }
+    # Full document: stdout (for humans / logs) AND a local file -- the
+    # driver's stdout tail capture truncates the big object mid-line
+    # (VERDICT r5 weak #4: BENCH_r05.json.parsed was null), so the file is
+    # the durable full record and the COMPACT summary below, printed as
+    # the final stdout line, is what the driver parses.
+    here = os.path.dirname(os.path.abspath(__file__))
+    local_path = os.environ.get("BENCH_LOCAL_PATH") or os.path.join(
+        here, "BENCH_local_latest.json"
+    )
+    print(json.dumps(doc))
+    try:
+        with open(local_path, "w") as f:
+            json.dump(doc, f, indent=1)
+    except OSError as e:  # read-only checkout: the summary still prints
+        print(f"bench: could not write {local_path}: {e}", file=sys.stderr)
+
     print(
         json.dumps(
-            {
-                "metric": "batched_ingest_throughput",
-                "value": headline,
-                "unit": "values/s",
-                "vs_baseline": round(headline / host["add_per_s"], 2),
-                "configs": {
-                    "c0_host_python": host,
-                    "c0_host_native": bench_native(),
-                    "c0_jax_scalar": bench_jax_scalar(),
-                    "c1_10k_streams": c1,
-                    "c2_c4_1m_streams_cubic_collapsing": c2c4,
-                    "c2s_shard_query_131k": c2s,
-                    "c3_distributed": c3,
-                    "serde_bulk": bench_serde(),
-                },
-                "membw_read": membw,
-                "verify_pallas_vs_xla_on_device": verify,
-                "host_sync_floor_s": sync_floor_s,
-                "device": device,
-            }
+            compact_summary(doc, os.path.basename(local_path)),
+            separators=(",", ":"),
         )
     )
 

@@ -1032,6 +1032,10 @@ class BatchedDDSketch:
             self._add_pallas = None
             self._batch_ok = lambda s: False
         # Query engines, fastest-eligible first (see _query_fn):
+        # * overlap Pallas kernel -- the tile-list walk with manual
+        #   double-buffered async copies (DMA ring + cross-block
+        #   lookahead), hiding the fold/count/decode under the strided
+        #   reads (same plan + parity contract as the tile engine);
         # * tile-list Pallas kernel -- hierarchical rank selection off the
         #   state's tile summaries; HBM bytes scale with the number of
         #   distinct crossing tiles (float bins, TPU, small Q);
@@ -1048,6 +1052,7 @@ class BatchedDDSketch:
         self._interpret = interpret
         self._windowed_jits = {}
         self._tiles_jits = {}
+        self._overlap_jits = {}
         self._wxla_jits = {}
         self._window_plan = None
         self._tile_plans = {}
@@ -1194,10 +1199,26 @@ class BatchedDDSketch:
                     )
                     self._tile_plans[qs_tuple] = plan
                 k_tiles, with_neg_t = plan
-                if (
-                    kernels.choose_query_engine(self._window_plan, plan)
-                    == "tiles"
-                ):
+                pick = kernels.choose_query_engine(
+                    self._window_plan, plan,
+                    overlap_ok=kernels.overlap_enabled(),
+                )
+                if pick == "overlap":
+                    key = (k_tiles, with_neg_t, q_total)
+                    fn = self._overlap_jits.get(key)
+                    if fn is None:
+                        fn = jax.jit(
+                            functools.partial(
+                                kernels.fused_quantile_tiles_overlap,
+                                self.spec,
+                                k_tiles=k_tiles,
+                                with_neg=with_neg_t,
+                                interpret=self._interpret,
+                            )
+                        )
+                        self._overlap_jits[key] = fn
+                    return fn
+                if pick == "tiles":
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
                     if fn is None:

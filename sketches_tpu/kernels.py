@@ -39,6 +39,7 @@ falls back to the XLA path otherwise.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -56,6 +57,7 @@ __all__ = [
     "fused_quantile",
     "fused_quantile_windowed",
     "fused_quantile_tiles",
+    "fused_quantile_tiles_overlap",
     "quantile_windowed_xla",
     "plan_tile_query",
     "tile_query_eligible",
@@ -1045,8 +1047,19 @@ def tile_query_eligible(spec: SketchSpec, q_total: int, window_plan) -> bool:
     )
 
 
-def choose_query_engine(window_plan, tile_plan) -> str:
-    """The facades' tiles-vs-windowed policy, in ONE place.
+#: Environment kill switch for the overlap engine: set to "0" to make both
+#: facades fall back to the r5 windowed/tiles ladder without a code change
+#: (the measured-dead escape hatch -- DESIGN.md 3c-r6).
+OVERLAP_ENV = "SKETCHES_TPU_OVERLAP"
+
+
+def overlap_enabled() -> bool:
+    """Whether the facades may route eligible queries to the overlap engine."""
+    return os.environ.get(OVERLAP_ENV, "1") != "0"
+
+
+def choose_query_engine(window_plan, tile_plan, overlap_ok: bool = False) -> str:
+    """The facades' windowed/tiles/overlap policy, in ONE place.
 
     ``window_plan`` = (lo_w, n_w, w_tiles, with_neg) from
     :func:`plan_state_window`; ``tile_plan`` = (k_tiles, with_neg) from
@@ -1062,6 +1075,15 @@ def choose_query_engine(window_plan, tile_plan) -> str:
     strictly beats the span (bytes) or when the negative store
     participates (the windowed kernel then scans BOTH spans; the tile
     fold's per-tile compute is far cheaper).
+
+    ``overlap_ok`` admits the manually double-buffered variant of the
+    tile engine (:func:`fused_quantile_tiles_overlap` -- same bytes, same
+    plan, explicit DMA/compute overlap; DESIGN.md 3c-r6).  With it set,
+    every case the tile engine would take goes to the overlap engine, and
+    so does the equal-byte positive-only tie the windowed kernel used to
+    win: that tie-break measured the tile engine's *serialized* final
+    cell, which is exactly the compute the overlap engine hides under the
+    next block's reads.
     """
     if tile_plan is None:
         return "windowed"
@@ -1072,6 +1094,8 @@ def choose_query_engine(window_plan, tile_plan) -> str:
         return "windowed"
     k_eff = k_tiles * (2 if with_neg_t else 1)
     win_eff = span * (2 if with_neg_w else 1)
+    if overlap_ok and (with_neg_t or k_eff <= win_eff):
+        return "overlap"
     return "tiles" if (with_neg_t or k_eff < win_eff) else "windowed"
 
 
@@ -1474,33 +1498,10 @@ def fused_quantile_tiles(
     if not 1 <= k_tiles <= t:
         raise ValueError(f"k_tiles={k_tiles} outside [1, {t}]")
 
-    utile, thr_adj, zflag, _ = _tile_targets(spec, state, qs)
-    nanflag = _invalid_mask(state, qs)
-    bits_pos, bits_neg = _tile_bits(utile, zflag, nanflag, t)
-    lists_pos, lists_neg = _block_tile_lists(
-        bits_pos, bits_neg, t, bn, k_tiles
+    lists_pos, lists_neg, packed = _tile_query_operands(
+        spec, state, qs, bn, k_tiles
     )
-    # Everything the final cell's decode needs rides in the packed block:
-    # the kernel emits FINAL values (incl. NaN validity), because any
-    # [N, Q]-shaped XLA work after the pallas barrier is left unfused with
-    # layout-copy chains (measured 3 ms of 3.8 ms total at 131k streams).
-    f32col = lambda x: x.astype(jnp.float32)[:, None]
-    packed = jnp.concatenate(
-        [
-            thr_adj,
-            utile.astype(jnp.float32),
-            zflag,
-            nanflag.astype(jnp.float32),
-            f32col(state.key_offset),
-            f32col(state.pos_lo), f32col(state.pos_hi),
-            f32col(state.neg_lo), f32col(state.neg_hi),
-        ],
-        axis=1,
-    )  # [N, 4Q + 5]
-    w = packed.shape[1]
-    wp = ((w + 7) // 8) * 8
-    if wp != w:
-        packed = jnp.pad(packed, ((0, 0), (0, wp - w)))
+    wp = packed.shape[1]
 
     n_prefetch = 2 if with_neg else 1
     pk_spec = pl.BlockSpec((bn, wp), lambda i, j, *_: (i, 0))
@@ -1528,6 +1529,267 @@ def fused_quantile_tiles(
             q_total=q_total,
             bn=bn,
             with_neg=with_neg,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, q_total), jnp.float32),
+        interpret=interpret,
+    )(*prefetch, *operands)
+
+
+# ---------------------------------------------------------------------------
+# Overlap query engine: the tile-list walk with MANUAL double buffering
+# (VERDICT r5 next #1 / DESIGN.md 3c-r6).  Same plan, same bytes, same
+# finalization as fused_quantile_tiles; the difference is who schedules the
+# DMAs.  The automatic Mosaic pipeline at the (block, list-slot) cell shape
+# overlaps nothing (the r5 P1->P3 additivity proof), so this engine walks
+# ONE grid cell per stream block, keeps the bins operands in ANY memory,
+# and issues explicit async copies into a `depth`-deep VMEM ring: while
+# tile j folds, tiles j+1..j+depth-1 stream -- including ACROSS the block
+# boundary, so the final cell's count/decode (the largest serialized
+# compute term, ~0.37 ms of the r5 worst case) runs under the next
+# block's reads instead of after its own.
+# ---------------------------------------------------------------------------
+
+
+def _tile_query_operands(spec, state, qs, bn, k_tiles):
+    """The tile-family kernels' shared XLA-side inputs ->
+    ``(lists_pos, lists_neg, packed)``.
+
+    Everything the final cell's decode needs rides in the packed block:
+    the kernels emit FINAL values (incl. NaN validity), because any
+    [N, Q]-shaped XLA work after the pallas barrier is left unfused with
+    layout-copy chains (measured 3 ms of 3.8 ms total at 131k streams).
+    """
+    t = spec.n_tiles
+    utile, thr_adj, zflag, _ = _tile_targets(spec, state, qs)
+    nanflag = _invalid_mask(state, qs)
+    bits_pos, bits_neg = _tile_bits(utile, zflag, nanflag, t)
+    lists_pos, lists_neg = _block_tile_lists(
+        bits_pos, bits_neg, t, bn, k_tiles
+    )
+    f32col = lambda x: x.astype(jnp.float32)[:, None]
+    packed = jnp.concatenate(
+        [
+            thr_adj,
+            utile.astype(jnp.float32),
+            zflag,
+            nanflag.astype(jnp.float32),
+            f32col(state.key_offset),
+            f32col(state.pos_lo), f32col(state.pos_hi),
+            f32col(state.neg_lo), f32col(state.neg_hi),
+        ],
+        axis=1,
+    )  # [N, 4Q + 5]
+    w = packed.shape[1]
+    wp = ((w + 7) // 8) * 8
+    if wp != w:
+        packed = jnp.pad(packed, ((0, 0), (0, wp - w)))
+    return lists_pos, lists_neg, packed
+
+
+def _overlap_depth(n_steps: int, requested: int) -> int:
+    """Ring depth: largest divisor of ``n_steps`` not above ``requested``.
+
+    The divisibility requirement keeps every global step's ring slot
+    static (``slot = step % depth`` with ``depth | steps-per-block`` means
+    the slot depends only on the in-block step index, never the traced
+    block id) -- dynamic slot arithmetic would force traced indexing into
+    the VMEM ring.
+    """
+    for d in (8, 4, 2, 1):
+        if d <= requested and d <= n_steps and n_steps % d == 0:
+            return d
+    return 1
+
+
+def _overlap_kernel(
+    *refs,
+    spec: SketchSpec,
+    q_total: int,
+    bn: int,
+    with_neg: bool,
+    k_tiles: int,
+    depth: int,
+    strip: Optional[str],
+):
+    """One stream block of the overlap query (grid is 1-D over blocks).
+
+    Per block: ``n_steps`` = k_tiles (pos) or 2*k_tiles (pos then neg)
+    list slots, each one explicit async copy of a [bn, 128] tile slab from
+    the ANY-space bins into ring slot ``j % depth``.  Step j waits its
+    slot, folds (the tile kernel's mask-mult-add, fresh-gated against
+    list pads), then refills the slot with the DMA for step ``j + depth``
+    -- whose block index may be ``i + 1``: the lists are scalar-prefetch
+    SMEM arrays, indexable at any block, so the lookahead runs past the
+    block boundary and the finalization below executes with up to
+    ``depth - 1`` of the NEXT block's reads in flight.  The finalization
+    itself is byte-identical work to the tile kernel's
+    (:func:`_count_and_decode`).
+
+    ``strip`` serves bench.py's P1-style decomposition ONLY (DESIGN.md
+    3c-r5 protocol): 'dma' keeps the copies + one plain add per fetched
+    tile (the reads cannot be elided); 'fold' keeps the full fold but
+    replaces the finalization with a slab slice.  Parity holds only for
+    ``strip=None``.
+    """
+    if with_neg:
+        (lp_ref, ln_ref, packed_ref, bp_hbm, bn_hbm, out_ref,
+         acc, ring, sem) = refs
+    else:
+        (lp_ref, packed_ref, bp_hbm, out_ref, acc, ring, sem) = refs
+    i = pl.program_id(0)
+    nb = pl.num_programs(0)
+    t = spec.n_tiles
+    n_steps = (2 if with_neg else 1) * k_tiles
+
+    def list_and_store(j):
+        # Static per step: which list/operand serves it, and the in-list
+        # slot.  Pos steps first, then (with_neg) the neg steps.
+        if with_neg and j >= k_tiles:
+            return ln_ref, bn_hbm, j - k_tiles
+        return lp_ref, bp_hbm, j
+
+    def make_dma(j, ib, slot):
+        lref, hbm, jj = list_and_store(j)
+        pid = lref[ib, jj]
+        return pltpu.make_async_copy(
+            hbm.at[pl.ds(ib * bn, bn), pl.ds(pid * LO, LO)],
+            ring.at[slot],
+            sem.at[slot],
+        )
+
+    acc[:] = jnp.zeros_like(acc)
+
+    @pl.when(i == 0)
+    def _():  # warm-up: the first block has no predecessor to prefetch it
+        for g in range(depth):
+            make_dma(g, jnp.int32(0), g).start()
+
+    pk = packed_ref[:]  # [bn, 4Q + 5 (+pad)]
+    utile = pk[:, q_total : 2 * q_total]
+
+    for j in range(n_steps):
+        slot = j % depth  # static: depth | n_steps (see _overlap_depth)
+        make_dma(j, i, slot).wait()
+        blk = ring[slot]
+        lref, _, jj = list_and_store(j)
+        if strip == "dma":
+            # P1: reads + one plain add/store; no per-q fold, no decode.
+            acc[:bn, :] += blk
+        else:
+            pid_f = (lref[i, jj] + (t if with_neg and j >= k_tiles else 0)
+                     ).astype(jnp.float32)
+            mf = (utile == pid_f).astype(jnp.float32)
+            if jj > 0:
+                # Fresh-occurrence gate (list pads repeat their
+                # predecessor and must not re-fold); the repeat's DMA is
+                # re-issued here, unlike the auto-pipeline's elision --
+                # its bytes are the price of manual scheduling, zero in
+                # the window-filling worst case (full unions, no pads).
+                fresh = lref[i, jj] != lref[i, jj - 1]
+                mf = jnp.where(fresh, mf, 0.0)
+            for q in range(q_total):
+                acc[q * bn : (q + 1) * bn, :] += mf[:, q : q + 1] * blk
+        g = j + depth
+        ib = i + g // n_steps
+        jn = g % n_steps
+
+        @pl.when(ib < nb)
+        def _(ib=ib, jn=jn, slot=slot):
+            make_dma(jn, ib, slot).start()
+
+    if strip is None:
+        out_ref[:] = _count_and_decode(
+            acc[:], pk, spec=spec, q_total=q_total, bn=bn, with_neg=with_neg
+        )
+    else:
+        # Stripped finalization: one slab slice so the folds stay live.
+        out_ref[:] = acc[:bn, :q_total]
+
+
+def fused_quantile_tiles_overlap(
+    spec: SketchSpec,
+    state: SketchState,
+    qs: jax.Array,
+    *,
+    k_tiles: int,
+    with_neg: bool = True,
+    block_streams: int = 0,
+    lookahead: int = 8,
+    interpret: bool = False,
+    _strip: Optional[str] = None,
+) -> jax.Array:
+    """Tile-list multi-quantile query with manual DMA/compute overlap.
+
+    Semantics and plan contract are identical to
+    :func:`fused_quantile_tiles` (same ``plan_tile_query`` output, same
+    tile-summary exactness tiers, same NaN semantics) -- the two engines
+    share the XLA-side operand prep and the in-kernel finalization, and
+    differ only in DMA scheduling.  ``lookahead`` bounds the VMEM ring
+    depth (actual depth = its largest divisor of the step count); the
+    ring costs ``depth * bn * 512`` bytes of VMEM next to the
+    ``[Q*bn, 128]`` accumulator slab.  ``_strip`` is bench-only (see
+    :func:`_overlap_kernel`).
+    """
+    n = state.n_streams
+    t = spec.n_tiles
+    if spec.bins_integer:
+        raise NotImplementedError(
+            "fused_quantile_tiles_overlap requires float bins; integer-bin"
+            " specs query via quantile_windowed_xla (exact integer compare)"
+        )
+    if spec.n_bins % LO != 0:
+        raise ValueError("tile-list query requires 128-aligned n_bins")
+    qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
+    q_total = qs.shape[0]
+    if q_total == 0:
+        return jnp.zeros((n, 0), jnp.float32)
+    bn = block_streams or _stream_block(n)
+    if n % bn != 0:
+        raise ValueError(
+            f"n_streams={n} must be a multiple of the stream block ({bn})"
+        )
+    if not 1 <= k_tiles <= t:
+        raise ValueError(f"k_tiles={k_tiles} outside [1, {t}]")
+    if lookahead < 1:
+        raise ValueError(f"lookahead={lookahead} must be >= 1")
+    n_steps = (2 if with_neg else 1) * k_tiles
+    depth = _overlap_depth(n_steps, lookahead)
+
+    lists_pos, lists_neg, packed = _tile_query_operands(
+        spec, state, qs, bn, k_tiles
+    )
+    wp = packed.shape[1]
+
+    n_prefetch = 2 if with_neg else 1
+    pk_spec = pl.BlockSpec((bn, wp), lambda i, *_: (i, 0))
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    in_specs = [pk_spec, any_spec] + ([any_spec] if with_neg else [])
+    operands = [packed, state.bins_pos] + (
+        [state.bins_neg] if with_neg else []
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(n // bn,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bn, q_total), lambda i, *_: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((q_total * bn, 128), jnp.float32),  # rank slab
+            pltpu.VMEM((depth, bn, LO), jnp.float32),      # DMA ring
+            pltpu.SemaphoreType.DMA((depth,)),
+        ],
+    )
+    prefetch = [lists_pos] + ([lists_neg] if with_neg else [])
+    return pl.pallas_call(
+        functools.partial(
+            _overlap_kernel,
+            spec=spec,
+            q_total=q_total,
+            bn=bn,
+            with_neg=with_neg,
+            k_tiles=k_tiles,
+            depth=depth,
+            strip=_strip,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((n, q_total), jnp.float32),

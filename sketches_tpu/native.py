@@ -126,7 +126,7 @@ class NativeDDSketch:
 
     Same static-window semantics as the device tier: keys clamp into
     ``[key_offset, key_offset + n_bins)``; ``add_batch`` is the fast path.
-    All three mappings are supported (the engine keys values with the same
+    All four mappings are supported (the engine keys values with the same
     scalar-path semantics as ``sketches_tpu.mapping``), so the host
     pre-aggregator can feed a device batch of any mapping -- including the
     cubic mapping of the flagship 1M-stream config (VERDICT r2 item 5).

@@ -418,9 +418,10 @@ class DistributedDDSketch:
             ),
             donate_argnums=(0,),
         )
-        # Query engine ladder, mirroring BatchedDDSketch._query_fn but with
-        # every Pallas path running per-shard inside shard_map on the folded
-        # state (qs replicated; a stream-sharded query has no collective).
+        # Query engine ladder (overlap/tiles/windowed/wxla), mirroring
+        # BatchedDDSketch._query_fn but with every Pallas path running
+        # per-shard inside shard_map on the folded state (qs replicated; a
+        # stream-sharded query has no collective).
         # Plans are GLOBAL -- folded from every shard's counters in one tiny
         # host fetch -- and shard boundaries are stream-block-aligned, so a
         # global plan bound holds shard-locally.  Integer-bin specs take the
@@ -429,6 +430,7 @@ class DistributedDDSketch:
         self._wxla_ok = spec.n_bins % 128 == 0
         self._windowed_jits = {}
         self._tiles_jits = {}
+        self._overlap_jits = {}
         self._wxla_jits = {}
         self._tile_plans = {}
         self._smap = smap
@@ -578,10 +580,33 @@ class DistributedDDSketch:
                     )
                     self._tile_plans[qs_tuple] = plan
                 k_tiles, with_neg_t = plan
-                if (
-                    kernels.choose_query_engine(self._window_plan, plan)
-                    == "tiles"
-                ):
+                pick = kernels.choose_query_engine(
+                    self._window_plan, plan,
+                    overlap_ok=kernels.overlap_enabled(),
+                )
+                if pick == "overlap":
+                    key = (k_tiles, with_neg_t, q_total)
+                    fn = self._overlap_jits.get(key)
+                    if fn is None:
+
+                        def local_overlap(st_, qs_, k_tiles=k_tiles,
+                                          with_neg_t=with_neg_t, bn=bn):
+                            return kernels.fused_quantile_tiles_overlap(
+                                spec, st_, qs_,
+                                k_tiles=k_tiles, with_neg=with_neg_t,
+                                block_streams=bn, interpret=interpret,
+                            )
+
+                        fn = jax.jit(
+                            self._smap(
+                                local_overlap,
+                                in_specs=(self._merged_pspec_, P()),
+                                out_specs=P(self.stream_axis, None),
+                            )
+                        )
+                        self._overlap_jits[key] = fn
+                    return fn
+                if pick == "tiles":
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
                     if fn is None:

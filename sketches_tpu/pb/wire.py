@@ -21,10 +21,13 @@ with group-vectorized numpy:
   offset + zeroCount) and records zero-copy ``frombuffer`` views; groups
   then place as ONE fancy-indexed scatter per run length.  Anything
   non-canonical -- sparse ``binCounts`` maps, unpacked repeated doubles,
-  foreign field orders, unknown fields, negative dense masses -- falls
-  back per-message to the C++ ``FromString`` parser plus a careful scalar
-  placement with identical semantics to ``batched.from_host_sketches``
-  (out-of-window mass folds into the edge bins with collapse counters).
+  foreign field orders, unknown fields -- falls back per-message to the
+  C++ ``FromString`` parser plus a careful scalar placement with
+  identical semantics to ``batched.from_host_sketches`` (out-of-window
+  mass folds into the edge bins with collapse counters).  Negative dense
+  masses stay on the group path: ``_Decoder.flush_groups`` clips them
+  with ``merge_into``-equivalent semantics (mass counted post-clip), so
+  no fallback is needed for them.
 
 Mapping gates are shared with ``pb.proto.KeyMappingProto``: LINEAR foreign
 bytes refuse by default, unknown enum values raise, NONE/QUADRATIC/CUBIC
@@ -422,6 +425,12 @@ def _parse_canonical(blob: bytes, start: int, i: int, base: int):
                 if blob[pend] != 0x18 or pend + 1 >= end_body:
                     return None
                 z, nxt = _read_varint(blob, pend + 1)
+                # Protobuf sint32 semantics: the varint TRUNCATES to its
+                # low 32 bits before zigzag decode (a >32-bit offset
+                # varint is legal on the wire; the C++ FromString path
+                # truncates, so the fast path must too or the two decode
+                # paths diverge on the same foreign bytes -- ADVICE r5).
+                z &= 0xFFFFFFFF
                 key_off = (z >> 1) ^ -(z & 1)
                 if nxt != end_body:
                     return None
@@ -515,6 +524,7 @@ class _Template:
                     if not blob[k] & 0x80:
                         return None
                 z, _ = _read_varint(blob, off_a)
+                z &= 0xFFFFFFFF  # protobuf sint32 truncation (see above)
                 key_off = (z >> 1) ^ -(z & 1)
             stripped = blob[p0:pend].rstrip(b"\x00")
             t_len = (len(stripped) + 7) >> 3

@@ -1,0 +1,48 @@
+"""The driver-parse contract of bench.py's final stdout line.
+
+VERDICT r5 weak #4: ``BENCH_r*.json.parsed`` was null because the full
+benchmark document overflowed the driver's stdout tail capture.  bench.py
+now ends with ONE compact single-line summary; these tests pin that the
+summary builds from a real benchmark document, stays small, and survives
+``json.loads`` -- including when configs were skipped.
+"""
+
+import json
+import os
+
+import bench
+
+
+def _real_doc():
+    """The last committed full local capture (a REAL doc shape), if any."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in sorted(os.listdir(here), reverse=True):
+        if name.startswith("BENCH_local_") and name.endswith(".json"):
+            with open(os.path.join(here, name)) as f:
+                return json.load(f), name
+    return None, None
+
+
+def test_compact_summary_is_small_single_line_json():
+    doc, name = _real_doc()
+    if doc is None:
+        doc, name = {"metric": "m", "value": 1, "configs": {}}, "x.json"
+    summary = bench.compact_summary(doc, name)
+    line = json.dumps(summary, separators=(",", ":"))
+    assert "\n" not in line
+    assert len(line) < 1500, len(line)  # must survive a tail capture
+    back = json.loads(line)
+    assert back["metric"] == doc.get("metric")
+    assert back["full_doc"] == name
+
+
+def test_compact_summary_total_on_skipped_configs():
+    """--skip-1m (or a failed config) leaves holes; the summary must
+    still build and parse."""
+    for doc in ({}, {"configs": {"c2s_shard_query_131k": None}},
+                {"configs": {"c0_jax_scalar": {"add_per_s": 2.9e6}}}):
+        line = json.dumps(
+            bench.compact_summary(doc, "BENCH_local_x.json"),
+            separators=(",", ":"),
+        )
+        assert json.loads(line)["full_doc"] == "BENCH_local_x.json"

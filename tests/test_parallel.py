@@ -225,6 +225,31 @@ def test_pallas_engine_distributed_matches_xla():
     )
 
 
+def test_overlap_engine_distributed_matches_xla():
+    """Mixed-sign data routes the distributed pallas facade to the overlap
+    engine (manual DMA double buffering) per shard; results must match
+    the XLA facade and the jit must be cached under the overlap ladder."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("streams",))
+    kwargs = dict(mesh=mesh, value_axis=None, stream_axis="streams", spec=SPEC)
+    pal = DistributedDDSketch(n_streams=256, engine="pallas", **kwargs)
+    xla = DistributedDDSketch(n_streams=256, engine="xla", **kwargs)
+    rng = np.random.RandomState(17)
+    values = (
+        rng.lognormal(0, 2.0, (256, 512))
+        * np.where(rng.rand(256, 512) < 0.4, -1.0, 1.0)
+    ).astype(np.float32)
+    pal.add(values)
+    xla.add(values)
+    np.testing.assert_allclose(
+        np.asarray(pal.get_quantile_values(QS)),
+        np.asarray(xla.get_quantile_values(QS)),
+        rtol=1e-4,
+    )
+    assert pal._overlap_jits, "overlap engine not selected for mixed data"
+
+
 def test_pallas_engine_distributed_rejects_misaligned_shards():
     with pytest.raises(ValueError, match="per-shard"):
         DistributedDDSketch(

@@ -58,6 +58,57 @@ def test_tiles_parity(regime):
 
 
 @pytest.mark.parametrize("regime", sorted(REGIMES))
+def test_overlap_parity(regime):
+    """The overlap engine (manual double-buffered DMA ring) is the tile
+    walk with different scheduling: it must be BIT-IDENTICAL to the tile
+    kernel and parity-exact vs the portable path in every regime."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    st = _mk(spec, 256, REGIMES[regime])
+    ref = np.asarray(quantile(spec, st, QS))
+    k_tiles, with_neg = kernels.plan_tile_query(spec, st, QS)
+    tiles = np.asarray(
+        kernels.fused_quantile_tiles(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    got = np.asarray(
+        kernels.fused_quantile_tiles_overlap(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    np.testing.assert_array_equal(
+        np.nan_to_num(got, nan=1.25), np.nan_to_num(tiles, nan=1.25)
+    )
+
+
+@pytest.mark.parametrize("lookahead", [1, 2, 3, 8])
+def test_overlap_lookahead_depths(lookahead):
+    """Every ring depth (incl. the depth-1 degenerate pipeline and a
+    non-divisor request that must round down) folds identically."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    st = _mk(spec, 256, REGIMES["mixed_sign"])
+    ref = np.asarray(quantile(spec, st, QS))
+    k_tiles, with_neg = kernels.plan_tile_query(spec, st, QS)
+    got = np.asarray(
+        kernels.fused_quantile_tiles_overlap(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg,
+            lookahead=lookahead, interpret=True,
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+
+
+def test_overlap_depth_divisor_rule():
+    """The ring depth is the largest divisor of the step count not above
+    the request (static slots need depth | steps-per-block)."""
+    d = kernels._overlap_depth
+    assert d(8, 8) == 8 and d(8, 5) == 4 and d(8, 3) == 2 and d(8, 1) == 1
+    assert d(6, 4) == 2 and d(6, 8) == 2  # 6 steps: pow2 divisors are 1, 2
+    assert d(2, 8) == 2 and d(1, 8) == 1
+
+
+@pytest.mark.parametrize("regime", sorted(REGIMES))
 def test_windowed_xla_parity(regime):
     spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
     st = _mk(spec, 256, REGIMES[regime])
@@ -99,6 +150,16 @@ def test_tiles_per_stream_offsets():
     close = np.isclose(got, ref, rtol=1e-6, equal_nan=True)
     assert close.mean() > 0.98, close.mean()
     np.testing.assert_allclose(got, ref, rtol=2.1e-2, equal_nan=True)
+    # The overlap engine decodes through the same per-stream offsets and
+    # must agree with the tile kernel to the bit.
+    got_o = np.asarray(
+        kernels.fused_quantile_tiles_overlap(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(
+        np.nan_to_num(got_o, nan=1.25), np.nan_to_num(got, nan=1.25)
+    )
     lo_w, n_w, w_t, wn = kernels.plan_state_window(spec, st)
     got2 = np.asarray(
         kernels.quantile_windowed_xla(
@@ -126,6 +187,12 @@ def test_tiles_empty_and_partial():
         )
     )
     np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    got_o = np.asarray(
+        kernels.fused_quantile_tiles_overlap(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    np.testing.assert_allclose(got_o, ref, rtol=1e-6, equal_nan=True)
 
 
 def test_windowed_xla_integer_exact_past_f32():
@@ -183,8 +250,28 @@ def test_facade_pallas_engine_ladder_dispatch():
     ref = np.asarray(quantile(sk.spec, sk.state, jnp.asarray([0.5, 0.9, 0.99])))
     np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
     # Mixed-sign wide data plans a multi-tile window with the neg store:
-    # the tile-list kernel must have been selected and cached.
-    assert sk._tiles_jits, "tile-list kernel not selected for wide mixed data"
+    # the overlap engine (the tile walk, manually double-buffered) is the
+    # default pick for that plan since r6.
+    assert sk._overlap_jits, "overlap kernel not selected for wide mixed data"
+    assert not sk._tiles_jits
+
+
+def test_facade_overlap_kill_switch(monkeypatch):
+    """SKETCHES_TPU_OVERLAP=0 falls the facade back to the r5 ladder
+    (tile kernel) with identical results -- the measured-dead escape
+    hatch must actually disconnect the engine."""
+    monkeypatch.setenv(kernels.OVERLAP_ENV, "0")
+    sk = BatchedDDSketch(256, n_bins=512, engine="pallas")
+    rng = np.random.RandomState(11)
+    data = (
+        rng.lognormal(0, 2.0, (256, 1024))
+        * np.where(rng.rand(256, 1024) < 0.3, -1.0, 1.0)
+    ).astype(np.float32)
+    sk.add(data)
+    got = np.asarray(sk.get_quantile_values([0.5, 0.9, 0.99]))
+    ref = np.asarray(quantile(sk.spec, sk.state, jnp.asarray([0.5, 0.9, 0.99])))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    assert sk._tiles_jits and not sk._overlap_jits
 
 
 def test_tiles_wide_q_falls_back():
@@ -244,6 +331,16 @@ def test_tiles_parity_wide_windows(n_bins):
     # differently on the CPU backend (on TPU the same data matches at
     # 1e-6).  Still 3 orders below a bucket width (2 * alpha).
     np.testing.assert_allclose(got, ref, rtol=1e-5, equal_nan=True)
+    # Multi-word masks ride identically through the overlap engine (its
+    # lists/packed block come from the same _tile_query_operands).
+    got_o = np.asarray(
+        kernels.fused_quantile_tiles_overlap(
+            spec, st, QS, k_tiles=k_tiles, with_neg=with_neg, interpret=True
+        )
+    )
+    np.testing.assert_array_equal(
+        np.nan_to_num(got_o, nan=1.25), np.nan_to_num(got, nan=1.25)
+    )
 
 
 def test_tile_query_eligible_bounds():
@@ -278,3 +375,24 @@ def test_choose_query_engine_policy():
     assert choose((0, 1, 4, False), (4, False)) == "windowed"
     # Window strictly narrower than the tile bound -> windowed.
     assert choose((0, 2, 1, False), (4, False)) == "windowed"
+
+
+def test_choose_query_engine_overlap_policy():
+    """overlap_ok admits the double-buffered engine exactly where the tile
+    walk competes: every tiles case, plus the equal-byte positive-only tie
+    (whose r5 tie-break measured the serialized final cell the overlap
+    engine hides)."""
+    choose = kernels.choose_query_engine
+    # Single-tile spans and missing plans stay windowed.
+    assert choose((0, 1, 1, False), (1, False), overlap_ok=True) == "windowed"
+    assert choose((0, 2, 2, False), None, overlap_ok=True) == "windowed"
+    # Every former tiles pick goes to overlap.
+    assert choose((0, 1, 4, True), (4, True), overlap_ok=True) == "overlap"
+    assert choose((0, 3, 1, False), (1, False), overlap_ok=True) == "overlap"
+    # The equal-byte positive-only tie flips to overlap.
+    assert choose((0, 1, 4, False), (4, False), overlap_ok=True) == "overlap"
+    # A strictly narrower window still wins.
+    assert choose((0, 2, 1, False), (4, False), overlap_ok=True) == "windowed"
+    # overlap_ok=False preserves the r5 ladder bit-for-bit.
+    assert choose((0, 1, 4, True), (4, True)) == "tiles"
+    assert choose((0, 1, 4, False), (4, False)) == "windowed"
