@@ -8,7 +8,7 @@ own data (one broadcast recenter to every partial, preserving the
 psum-merge invariant), `maybe_recenter()` chases a mid-stream regime
 shift, and the final states ship through the cross-language protobuf edge.
 
-Run anywhere (CPU or TPU; uses however many devices are visible):
+Run anywhere (CPU by default; pin JAX_PLATFORMS=tpu to use accelerators):
     python examples/heterogeneous_fleet.py
 """
 
@@ -16,6 +16,18 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SELF_PROVISIONED = __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ
+if _SELF_PROVISIONED:
+    # Self-provision a virtual CPU mesh when run standalone (the
+    # distributed_mesh.py pattern): with no explicit pin, backend
+    # discovery may attach to a remote/tunneled accelerator and crawl --
+    # an example must degrade to the portable platform, not hang.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", "")
+    )
 
 import numpy as np
 

@@ -8,7 +8,7 @@ A second "region" maintains its own sketch batch; cross-region aggregation
 is a single elementwise merge (on a real multi-pod deployment the same
 merge rides ICI/DCN collectives via sketches_tpu.parallel).
 
-Run anywhere (CPU or TPU):
+Run anywhere (CPU by default; pin JAX_PLATFORMS=tpu to use an accelerator):
     python examples/latency_monitoring.py
 """
 
@@ -16,6 +16,14 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SELF_PROVISIONED = __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ
+if _SELF_PROVISIONED:
+    # Self-provision the CPU platform when run standalone (the
+    # distributed_mesh.py pattern): with no explicit pin, backend
+    # discovery may attach to a remote/tunneled accelerator and crawl --
+    # an example must degrade to the portable platform, not hang.
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 

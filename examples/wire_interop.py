@@ -10,7 +10,7 @@ The bulk codec (``batched_to_bytes`` / ``batched_from_bytes``) is the
 fast path: vectorized numpy in/out, byte-identical to the per-sketch
 object bridge (``DDSketchProto``), ~1 s per 100k sketches.
 
-Run anywhere (CPU or TPU):
+Run anywhere (CPU by default; pin JAX_PLATFORMS=tpu to use an accelerator):
     python examples/wire_interop.py
 """
 
@@ -18,6 +18,14 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SELF_PROVISIONED = __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ
+if _SELF_PROVISIONED:
+    # Self-provision the CPU platform when run standalone (the
+    # distributed_mesh.py pattern): with no explicit pin, backend
+    # discovery may attach to a remote/tunneled accelerator and crawl --
+    # an example must degrade to the portable platform, not hang.
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 

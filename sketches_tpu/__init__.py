@@ -14,6 +14,7 @@ Two execution tiers:
   or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
+from sketches_tpu import faults, resilience
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
@@ -21,6 +22,19 @@ from sketches_tpu.ddsketch import (
     LogCollapsingHighestDenseDDSketch,
     LogCollapsingLowestDenseDDSketch,
     UnequalSketchParametersError,
+)
+from sketches_tpu.resilience import (
+    BlobTooLarge,
+    CheckpointCorrupt,
+    EngineUnavailable,
+    InjectedFault,
+    QuarantineReport,
+    ShardLossError,
+    ShardLossReport,
+    SketchError,
+    SketchValueError,
+    SpecError,
+    WireDecodeError,
 )
 from sketches_tpu.mapping import (
     CubicallyInterpolatedMapping,
@@ -38,7 +52,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.5.0"
+__version__ = "0.6.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -60,5 +74,19 @@ __all__ = [
     "SketchSpec",
     "SketchState",
     "DistributedDDSketch",
+    # Resilience layer (error taxonomy, fault injection, health ledger)
+    "resilience",
+    "faults",
+    "SketchError",
+    "SketchValueError",
+    "SpecError",
+    "WireDecodeError",
+    "BlobTooLarge",
+    "CheckpointCorrupt",
+    "EngineUnavailable",
+    "ShardLossError",
+    "ShardLossReport",
+    "InjectedFault",
+    "QuarantineReport",
     "__version__",
 ]

@@ -52,8 +52,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sketches_tpu import faults, resilience
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
 from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
+from sketches_tpu.resilience import SketchValueError, SpecError
 
 __all__ = [
     "SketchSpec",
@@ -119,9 +121,9 @@ class SketchSpec:
 
     def __post_init__(self):
         if not 0.0 < self.relative_accuracy < 1.0:
-            raise ValueError("Relative accuracy must be between 0 and 1.")
+            raise SpecError("Relative accuracy must be between 0 and 1.")
         if self.n_bins < 2:
-            raise ValueError("n_bins must be >= 2")
+            raise SpecError("n_bins must be >= 2")
         if self.key_offset is None:
             object.__setattr__(self, "key_offset", -(self.n_bins // 2))
         if self.bin_dtype is None:
@@ -1050,6 +1052,12 @@ class BatchedDDSketch:
         # cached until the next ingest/merge/recenter.
         self._pallas_query = use_pallas and not spec.bins_integer
         self._interpret = interpret
+        # Engine-health ladder state: tiers this facade demoted away from
+        # after a lowering/compile failure (resilience.QUERY_LADDER order).
+        # Every demotion is recorded in resilience.health(); the floor
+        # (the portable full-XLA quantile) never demotes -- it re-raises.
+        self._query_disabled: set = set()
+        self._health_component = "batched"
         self._windowed_jits = {}
         self._tiles_jits = {}
         self._overlap_jits = {}
@@ -1148,7 +1156,33 @@ class BatchedDDSketch:
             # kernels.add).
             and not (self.spec.bins_integer and weights is not None)
         ):
-            self._stream_op("add_pallas", self._add_pallas, values, weights)
+            try:
+                if faults._ACTIVE:
+                    faults.inject(faults.PALLAS_INGEST)
+                self._stream_op("add_pallas", self._add_pallas, values, weights)
+            except Exception as e:
+                # Pallas ingest lost (lowering/compile failure or injected
+                # fault): demote this facade to the XLA scatter path for
+                # good and replay the batch.  Failures surface at compile
+                # time -- before any donated buffer executes -- so the
+                # state is untouched and the replay is exact; the one
+                # pathological exception (an *execution* failure between
+                # chunks of a chunked dispatch) leaves donated buffers
+                # consumed, which the replay below then reports loudly
+                # instead of double-ingesting.
+                self._add_pallas = None
+                self._batch_ok = lambda s: False
+                resilience.record_downgrade(
+                    f"{self._health_component}.ingest", "pallas", "xla",
+                    repr(e),
+                )
+                try:
+                    self._stream_op("add_xla", self._add_xla, values, weights)
+                except Exception as e2:
+                    raise resilience.EngineUnavailable(
+                        "ingest failed on both the Pallas and XLA engines;"
+                        " state may be partial"
+                    ) from e2
         else:
             self._stream_op("add_xla", self._add_xla, values, weights)
         self._invalidate_plans()
@@ -1160,7 +1194,7 @@ class BatchedDDSketch:
         Costs a host sync on ``weights``; keep off the hot path.
         """
         if weights is not None and bool(jnp.any(jnp.asarray(weights) < 0)):
-            raise ValueError("weights must be non-negative (0 = padding)")
+            raise SketchValueError("weights must be non-negative (0 = padding)")
         return self.add(values, weights)
 
     def _invalidate_plans(self) -> None:
@@ -1168,7 +1202,13 @@ class BatchedDDSketch:
         self._tile_plans = {}
 
     def _query_fn(self, qs_tuple: tuple):
-        """The query dispatch (see the engine ladder in ``__init__``).
+        """The dispatched query callable (engine ladder in ``__init__``)."""
+        return self._query_choice(qs_tuple)[1]
+
+    def _query_choice(self, qs_tuple: tuple):
+        """The query dispatch -> ``(tier, fn)`` (engine ladder in
+        ``__init__``; ``tier`` names the resilience ladder rung so a
+        failure can demote exactly the engine that failed).
 
         Each plan costs one small host fetch the first query after a state
         mutation; repeat queries reuse it.  Jits cache per static plan
@@ -1178,7 +1218,8 @@ class BatchedDDSketch:
         from sketches_tpu import kernels
 
         q_total = len(qs_tuple)
-        if self._pallas_query:
+        disabled = self._query_disabled
+        if self._pallas_query and "windowed" not in disabled:
             if self._window_plan is None:
                 self._window_plan = kernels.plan_state_window(
                     self.spec, self.state
@@ -1187,7 +1228,7 @@ class BatchedDDSketch:
             # Eligibility and engine choice both live in kernels
             # (tile_query_eligible / choose_query_engine) so the two
             # facades can never drift apart on the policy (ADVICE r4).
-            if kernels.tile_query_eligible(
+            if "tiles" not in disabled and kernels.tile_query_eligible(
                 self.spec, q_total, self._window_plan
             ):
                 # Tile-list plan (list width + store participation)
@@ -1201,7 +1242,8 @@ class BatchedDDSketch:
                 k_tiles, with_neg_t = plan
                 pick = kernels.choose_query_engine(
                     self._window_plan, plan,
-                    overlap_ok=kernels.overlap_enabled(),
+                    overlap_ok=kernels.overlap_enabled()
+                    and "overlap" not in disabled,
                 )
                 if pick == "overlap":
                     key = (k_tiles, with_neg_t, q_total)
@@ -1217,7 +1259,7 @@ class BatchedDDSketch:
                             )
                         )
                         self._overlap_jits[key] = fn
-                    return fn
+                    return ("overlap", fn)
                 if pick == "tiles":
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
@@ -1232,7 +1274,7 @@ class BatchedDDSketch:
                             )
                         )
                         self._tiles_jits[key] = fn
-                    return fn
+                    return ("tiles", fn)
             key = (n_w, w_t, with_neg, q_total)
             fn = self._windowed_jits.get(key)
             if fn is None:
@@ -1247,10 +1289,13 @@ class BatchedDDSketch:
                     )
                 )
                 self._windowed_jits[key] = fn
-            return functools.partial(
-                lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w
+            return (
+                "windowed",
+                functools.partial(
+                    lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w
+                ),
             )
-        if self._wxla_ok:
+        if self._wxla_ok and "wxla" not in disabled:
             if self._window_plan is None:
                 self._window_plan = kernels.plan_state_window(
                     self.spec, self.state
@@ -1269,21 +1314,52 @@ class BatchedDDSketch:
                     )
                 )
                 self._wxla_jits[key] = fn
-            return functools.partial(
-                lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w * w_t
+            return (
+                "wxla",
+                functools.partial(
+                    lambda f, lo, state, qs: f(state, qs, lo), fn, lo_w * w_t
+                ),
             )
-        return self._quantile
+        return ("xla", self._quantile)
+
+    def _run_query(self, qs_tuple: tuple, qs_arr: jax.Array) -> jax.Array:
+        """Dispatch a query down the engine ladder, degrading on failure.
+
+        A lowering/compile failure on a Pallas tier (or an injected
+        ``pallas.lowering`` fault) demotes this facade to the next tier
+        -- recorded in ``resilience.health()`` -- and retries; the floor
+        tier re-raises.  Queries are pure (no state mutation), so a retry
+        after any failure is always sound.
+        """
+        while True:
+            tier, fn = self._query_choice(qs_tuple)
+            try:
+                if faults._ACTIVE:
+                    faults.inject(faults.PALLAS_LOWERING, tier=tier)
+                return fn(self.state, qs_arr)
+            except Exception as e:
+                if not self._demote_query(tier, e):
+                    raise
+
+    def _demote_query(self, tier: str, exc: BaseException) -> bool:
+        nxt = resilience.demote_query_tier(self._query_disabled, tier)
+        if nxt is None:
+            return False
+        resilience.record_downgrade(
+            f"{self._health_component}.query", tier, nxt, repr(exc)
+        )
+        return True
 
     def get_quantile_value(self, quantile: float) -> jax.Array:
         """Per-stream value at ``quantile`` -> ``[n_streams]`` (NaN if empty)."""
-        return self._query_fn((float(quantile),))(
-            self.state, jnp.asarray([quantile])
+        return self._run_query(
+            (float(quantile),), jnp.asarray([quantile])
         )[:, 0]
 
     def get_quantile_values(self, quantiles: Sequence[float]) -> jax.Array:
         """Fused multi-quantile (e.g. p50/p90/p99/p999) -> ``[n_streams, Q]``."""
         qs = [float(q) for q in quantiles]
-        return self._query_fn(tuple(qs))(self.state, jnp.asarray(qs))
+        return self._run_query(tuple(qs), jnp.asarray(qs))
 
     def merge(self, other: "BatchedDDSketch") -> "BatchedDDSketch":
         """Fold ``other`` into self (consumes neither spec; checks mergeability).
@@ -1524,6 +1600,9 @@ class BatchedDDSketch:
         new._policy_collapsed = self._policy_collapsed.copy()
         new._policy_binned = self._policy_binned.copy()
         new._policy_stale = self._policy_stale
+        # A demoted engine stays demoted in the copy (the failure that
+        # demoted it is a property of the environment, not the instance).
+        new._query_disabled = set(self._query_disabled)
         return new
 
     def __repr__(self) -> str:

@@ -7,12 +7,29 @@ go through this module instead: one ``device_get`` into a compressed npz of
 the raw state arrays plus the spec, and ``device_put`` back on restore --
 sketch state is one dense pytree, so checkpoint/resume is exactly an array
 save/load, no orchestration needed.
+
+Durability contract (r7):
+
+* **Atomic writes.**  ``save_state`` serializes to memory, writes a
+  same-directory temp file, fsyncs, and ``os.replace``s it into place --
+  a crash mid-write leaves the previous checkpoint intact, never a torn
+  file at ``path``.
+* **Validated restores.**  The npz carries a content checksum (sha256
+  over the spec json + every state array's name/dtype/shape/bytes).
+  ``restore_state`` turns ANY restore failure -- truncated or
+  corrupted archive, checksum mismatch, missing fields -- into a
+  :class:`~sketches_tpu.resilience.CheckpointCorrupt` with the path and
+  cause, never a bare numpy/zipfile stack trace.  Pre-r7 checkpoints
+  (no checksum member) still restore; they just skip the content check.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import io
 import json
+import os
 from typing import Tuple, Union
 
 import numpy as np
@@ -20,15 +37,31 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sketches_tpu import faults
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
+from sketches_tpu.resilience import CheckpointCorrupt
 
 __all__ = ["save", "restore", "restore_distributed", "save_state", "restore_state"]
 
 _FIELDS = [f.name for f in dataclasses.fields(SketchState)]
 
 
+def _digest(spec_json: str, arrays: dict) -> str:
+    """Content checksum over the spec + every array's identity and bytes."""
+    h = hashlib.sha256()
+    h.update(spec_json.encode())
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
 def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
-    """Write spec + state to ``path`` (npz; host round-trip, compressed)."""
+    """Write spec + state to ``path`` (npz; compressed, checksummed,
+    atomically renamed into place)."""
     arrays = {name: np.asarray(jax.device_get(getattr(state, name)))
               for name in _FIELDS}
     spec_json = json.dumps(
@@ -41,19 +74,72 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
             "bin_dtype": jnp.dtype(spec.bin_dtype).name,
         }
     )
-    # Write through a file object: np.savez on a bare path silently appends
-    # '.npz', which would break the save()/restore() round-trip for any
-    # other suffix.
-    with open(path, "wb") as f:
-        np.savez_compressed(
-            f, __spec__=np.frombuffer(spec_json.encode(), np.uint8), **arrays
-        )
+    # Serialize to memory first: the bytes hit disk in one write, so the
+    # only torn-write window left is the filesystem's own, which the
+    # tmp+rename below closes.  (Write through a file object: np.savez on
+    # a bare path silently appends '.npz', which would break the
+    # save()/restore() round-trip for any other suffix.)
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        __spec__=np.frombuffer(spec_json.encode(), np.uint8),
+        __checksum__=np.frombuffer(_digest(spec_json, arrays).encode(), np.uint8),
+        **arrays,
+    )
+    data = buf.getvalue()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        if faults._ACTIVE:
+            # "truncate" simulates a torn write reaching the final path;
+            # "raise" simulates a crash before the rename (the previous
+            # checkpoint must survive either way).
+            data = faults.inject(faults.CHECKPOINT_WRITE, payload=data)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
-    """Load (spec, state) previously written by ``save_state``."""
+    """Load (spec, state) previously written by ``save_state``.
+
+    Raises :class:`CheckpointCorrupt` on any integrity failure (torn
+    file, bad archive, checksum mismatch, missing members); a missing
+    file stays ``FileNotFoundError``.
+    """
+    try:
+        return _restore_state_inner(path)
+    except (FileNotFoundError, CheckpointCorrupt):
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} failed to restore"
+            f" ({type(e).__name__}: {e})"
+        ) from e
+
+
+def _restore_state_inner(path: str) -> Tuple[SketchSpec, SketchState]:
     with np.load(path) as data:
-        meta = json.loads(bytes(data["__spec__"]).decode())
+        meta_json = bytes(data["__spec__"]).decode()
+        meta = json.loads(meta_json)
+        if "__checksum__" in data.files:
+            stored = bytes(data["__checksum__"]).decode()
+            arrays_np = {
+                name: np.asarray(data[name])
+                for name in _FIELDS
+                if name in data.files
+            }
+            got = _digest(meta_json, arrays_np)
+            if got != stored:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r} checksum mismatch"
+                    f" (stored {stored[:12]}..., recomputed {got[:12]}...):"
+                    " content corrupted after write"
+                )
         spec = SketchSpec(
             relative_accuracy=meta["relative_accuracy"],
             mapping_name=meta["mapping_name"],

@@ -28,6 +28,11 @@ import typing
 import numpy as np
 
 from sketches_tpu.mapping import KeyMapping, LogarithmicMapping, zero_threshold
+from sketches_tpu.resilience import (
+    SketchValueError,
+    SpecError,
+    UnequalSketchParametersError,
+)
 from sketches_tpu.store import (
     CollapsingHighestDenseStore,
     CollapsingLowestDenseStore,
@@ -49,8 +54,10 @@ DEFAULT_BIN_LIMIT = 2048
 _F32_TINY = zero_threshold(np.float32)  # shared zero-bucket threshold
 
 
-class UnequalSketchParametersError(ValueError):
-    """Raised when merging sketches whose mappings (gamma/offset) differ."""
+# UnequalSketchParametersError lives in sketches_tpu.resilience since r7
+# (the structured error taxonomy); re-exported here so the historical
+# ``from sketches_tpu.ddsketch import UnequalSketchParametersError`` import
+# path -- and ``except ValueError`` handlers -- keep working.
 
 
 class BaseDDSketch:
@@ -125,7 +132,7 @@ class BaseDDSketch:
     def add(self, val: float, weight: float = 1.0) -> None:
         """Ingest ``val`` with multiplicity ``weight`` (> 0)."""
         if weight <= 0.0:
-            raise ValueError("weight must be positive")
+            raise SketchValueError("weight must be positive")
 
         if val > self._mapping.min_possible:
             self._store.add(self._mapping.key(val), weight)
@@ -353,7 +360,7 @@ class JaxDDSketch(BaseDDSketch):
     # -- core API ----------------------------------------------------------
     def add(self, val: float, weight: float = 1.0) -> None:
         if weight <= 0.0:
-            raise ValueError("weight must be positive")
+            raise SketchValueError("weight must be positive")
         # EVERY piece of scalar bookkeeping happens vectorized at flush
         # time: the per-add Python arithmetic (and especially the
         # ``np.float32(val)`` scalar cast zero classification used to do
@@ -389,7 +396,7 @@ class JaxDDSketch(BaseDDSketch):
                 np.asarray(weights, np.float64), v64.shape
             )
             if v64.size and not (w64 > 0.0).all():
-                raise ValueError("weight must be positive")
+                raise SketchValueError("weight must be positive")
         if v64.size == 0:
             return
         self._flush()  # drain buffered scalar adds ahead of this batch
@@ -706,7 +713,7 @@ class DDSketch(BaseDDSketch):
                 key_offset=key_offset,
             )
         if backend != "py":
-            raise ValueError(f"Unknown backend {backend!r}")
+            raise SpecError(f"Unknown backend {backend!r}")
         _reject_jax_only_kwargs(mapping=mapping, n_bins=n_bins, key_offset=key_offset)
         return super().__new__(cls)
 
@@ -794,7 +801,7 @@ class LogCollapsingLowestDenseDDSketch(BaseDDSketch):
                 relative_accuracy, bin_limit, mapping, key_offset
             )
         if backend != "py":
-            raise ValueError(f"Unknown backend {backend!r}")
+            raise SpecError(f"Unknown backend {backend!r}")
         _reject_jax_only_kwargs(mapping=mapping, key_offset=key_offset)
         return super().__new__(cls)
 
@@ -845,7 +852,7 @@ class LogCollapsingHighestDenseDDSketch(BaseDDSketch):
                 relative_accuracy, bin_limit, mapping, key_offset
             )
         if backend != "py":
-            raise ValueError(f"Unknown backend {backend!r}")
+            raise SpecError(f"Unknown backend {backend!r}")
         _reject_jax_only_kwargs(mapping=mapping, key_offset=key_offset)
         return super().__new__(cls)
 

@@ -1,0 +1,307 @@
+"""Deterministic fault-injection harness (off by default, zero hot-path cost).
+
+Resilience claims are only as good as the failures they were tested
+against, so the failure modes are first-class, *injectable* events:
+
+======================  ====================================================
+site                    simulates
+======================  ====================================================
+``wire.blob``           blob corruption in the bulk decode (mutates the
+                        blob bytes per index, deterministically)
+``native.load``         native-library build/load failure (raises inside
+                        ``native._load``; transient when ``times`` caps it)
+``pallas.lowering``     a Pallas query-kernel lowering/compile failure
+                        (raises at the facade dispatch, per engine ``tier``)
+``pallas.ingest``       a Pallas ingest-kernel failure
+``checkpoint.write``    a torn checkpoint write (``mode="truncate"``) or a
+                        crash before the atomic rename (``mode="raise"``)
+``mesh.shard``          dead value shard(s) -- consumed by
+                        ``DistributedDDSketch.merge_partial`` via
+                        :func:`dead_shards`
+======================  ====================================================
+
+Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
+process start via the ``SKETCHES_TPU_FAULTS`` environment variable --
+semicolon-separated ``site[:key=value,...]`` entries, e.g.
+``SKETCHES_TPU_FAULTS="native.load;wire.blob:fraction=0.01,seed=7"``.
+Both are OFF by default.
+
+Cost discipline: every injection seam guards on the module flag
+(``if faults._ACTIVE: faults.inject(...)``), so the disabled harness
+costs one attribute read + bool test per *dispatch* (not per value) --
+unmeasurable next to a device dispatch.  Determinism: a plan fires on a
+call-count cap (``times``) or on a seeded per-index hash (``fraction`` +
+``seed``); no wall-clock, no global RNG, so a failing sequence replays
+exactly.
+"""
+
+from __future__ import annotations
+
+import binascii
+import contextlib
+import os
+import threading
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+from sketches_tpu.resilience import InjectedFault, bump
+
+__all__ = [
+    "FAULTS_ENV",
+    "NATIVE_LOAD",
+    "PALLAS_LOWERING",
+    "PALLAS_INGEST",
+    "WIRE_BLOB",
+    "CHECKPOINT_WRITE",
+    "MESH_SHARD",
+    "SITES",
+    "arm",
+    "disarm",
+    "active",
+    "inject",
+    "dead_shards",
+    "stats",
+    "corrupt_blobs",
+]
+
+FAULTS_ENV = "SKETCHES_TPU_FAULTS"
+
+NATIVE_LOAD = "native.load"
+PALLAS_LOWERING = "pallas.lowering"
+PALLAS_INGEST = "pallas.ingest"
+WIRE_BLOB = "wire.blob"
+CHECKPOINT_WRITE = "checkpoint.write"
+MESH_SHARD = "mesh.shard"
+
+SITES = (
+    NATIVE_LOAD,
+    PALLAS_LOWERING,
+    PALLAS_INGEST,
+    WIRE_BLOB,
+    CHECKPOINT_WRITE,
+    MESH_SHARD,
+)
+
+#: Fast-path guard: seams check this module flag before calling
+#: :func:`inject`, so a fully disarmed harness costs one bool test.
+_ACTIVE = False
+
+_lock = threading.Lock()
+
+
+class _Plan:
+    """One armed site: when to fire and what to do.
+
+    ``times=None`` fires on every matching call; ``times=k`` fires on the
+    first k.  ``fraction`` + ``seed`` instead select per-``index``
+    deterministically (the blob-corruption style).  ``tier`` restricts a
+    ``pallas.lowering`` plan to one engine tier (or a tuple of tiers).
+    ``mode`` is what firing does: ``"raise"`` (default, raises ``exc`` or
+    :class:`InjectedFault`), ``"corrupt"`` / ``"truncate"`` (mutate the
+    payload bytes and return them).
+    """
+
+    __slots__ = (
+        "site", "times", "fraction", "seed", "mode", "tier", "shards",
+        "exc", "fired", "calls",
+    )
+
+    def __init__(
+        self,
+        site: str,
+        times: Optional[int] = None,
+        fraction: Optional[float] = None,
+        seed: int = 0,
+        mode: str = "raise",
+        tier=None,
+        shards: Sequence[int] = (),
+        exc: Optional[BaseException] = None,
+    ):
+        if mode not in ("raise", "corrupt", "truncate"):
+            raise ValueError(f"Unknown fault mode {mode!r}")
+        self.site = site
+        self.times = times
+        self.fraction = fraction
+        self.seed = int(seed)
+        self.mode = mode
+        self.tier = (tier,) if isinstance(tier, str) else tier
+        self.shards = tuple(int(s) for s in shards)
+        self.exc = exc
+        self.fired = 0
+        self.calls = 0
+
+
+_plans: Dict[str, _Plan] = {}
+
+
+def arm(site: str, **kwargs) -> None:
+    """Arm ``site`` with a :class:`_Plan` (see its docstring for knobs)."""
+    global _ACTIVE
+    if site not in SITES:
+        raise ValueError(f"Unknown fault site {site!r}; expected one of {SITES}")
+    with _lock:
+        _plans[site] = _Plan(site, **kwargs)
+        _ACTIVE = True
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Disarm one site (or all of them with no argument)."""
+    global _ACTIVE
+    with _lock:
+        if site is None:
+            _plans.clear()
+        else:
+            _plans.pop(site, None)
+        _ACTIVE = bool(_plans)
+
+
+@contextlib.contextmanager
+def active(spec: Dict[str, Optional[dict]]) -> Iterator[Dict[str, _Plan]]:
+    """Arm ``{site: kwargs}`` for the block; disarm on exit.
+
+    Yields the armed plans so callers can assert on ``fired`` counts.
+    """
+    armed = []
+    try:
+        for site, kw in spec.items():
+            arm(site, **(kw or {}))
+            armed.append(site)
+        yield {s: _plans[s] for s in armed}
+    finally:
+        for s in armed:
+            disarm(s)
+
+
+def stats() -> Dict[str, Tuple[int, int]]:
+    """Per-armed-site ``(calls seen, faults fired)``."""
+    with _lock:
+        return {s: (p.calls, p.fired) for s, p in _plans.items()}
+
+
+def _selected(seed: int, index: int, fraction: float) -> bool:
+    """Deterministic per-index coin flip at rate ``fraction``."""
+    h = binascii.crc32(f"{seed}:{index}".encode()) & 0xFFFFFFFF
+    return h < fraction * 2**32
+
+
+def inject(site: str, payload=None, index: Optional[int] = None, tier=None):
+    """The seam call: fire the armed plan for ``site``, if any.
+
+    Returns ``payload`` (possibly mutated for byte-mutation modes);
+    raises the plan's exception in ``"raise"`` mode.  A disarmed site is
+    a no-op returning ``payload`` unchanged.
+    """
+    plan = _plans.get(site)
+    if plan is None:
+        return payload
+    plan.calls += 1
+    if plan.tier is not None and tier is not None and tier not in plan.tier:
+        return payload
+    if plan.fraction is not None:
+        if index is None or not _selected(plan.seed, index, plan.fraction):
+            return payload
+    elif plan.times is not None and plan.fired >= plan.times:
+        return payload
+    plan.fired += 1
+    bump("faults." + site)
+    if plan.mode == "raise":
+        if plan.exc is not None:
+            raise plan.exc
+        raise InjectedFault(
+            f"injected fault at {site}" + (f" (tier={tier})" if tier else "")
+        )
+    if plan.mode == "truncate":
+        return payload[: max(1, len(payload) // 2)]
+    return _corrupt(payload, plan.seed, index or 0)
+
+
+def dead_shards(n_shards: int) -> Tuple[int, ...]:
+    """Armed dead value-shard indices within ``[0, n_shards)`` -- the
+    ``mesh.shard`` site's consumer-side read (it returns data rather than
+    raising, so it does not go through :func:`inject`)."""
+    if not _ACTIVE:
+        return ()
+    plan = _plans.get(MESH_SHARD)
+    if plan is None:
+        return ()
+    plan.calls += 1
+    dead = tuple(s for s in plan.shards if 0 <= s < n_shards)
+    if dead:
+        plan.fired += 1
+        bump("faults." + MESH_SHARD)
+    return dead
+
+
+# ---------------------------------------------------------------------------
+# Deterministic blob corruption
+# ---------------------------------------------------------------------------
+
+
+def _corrupt(blob: bytes, seed: int, index: int) -> bytes:
+    """Structurally-invalid corruption of one wire blob, by (seed, index).
+
+    Every mode is GUARANTEED unparseable by any protobuf parser (invalid
+    wire type 7 tag, or the illegal field number 0), so a corrupted blob
+    is always *detected* -- the corruption model for quarantine tests.
+    (A bit flip that yields different-but-valid bytes is undetectable
+    without a content checksum the DDSketch wire format does not carry;
+    that is the documented limit of the quarantine contract.)
+    """
+    mode = (seed + index) % 3
+    if mode == 0:
+        return b"\xff" + blob[1:]  # tag 0xff: wire type 7 (invalid)
+    if mode == 1:
+        return blob + b"\xff\xff\xff\xff\xff"  # trailing invalid tag
+    return b"\x00" + blob  # field number 0 (illegal)
+
+
+def corrupt_blobs(
+    blobs: Sequence[bytes], fraction: float, seed: int = 0
+) -> Tuple[list, list]:
+    """Corrupt a deterministic ~``fraction`` of ``blobs`` -> (new list,
+    corrupted indices).  Test/benchmark helper sharing the exact
+    selection + mutation the armed ``wire.blob`` site applies."""
+    out, idx = [], []
+    for i, b in enumerate(blobs):
+        if _selected(seed, i, fraction):
+            out.append(_corrupt(b, seed, i))
+            idx.append(i)
+        else:
+            out.append(b)
+    return out, idx
+
+
+# ---------------------------------------------------------------------------
+# Environment arming (process-level, for CI degraded-mode jobs)
+# ---------------------------------------------------------------------------
+
+
+def _coerce(v: str):
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        return v
+
+
+def _parse_env(value: str) -> None:
+    for part in value.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, kvs = part.partition(":")
+        kwargs: dict = {}
+        for kv in filter(None, (s.strip() for s in kvs.split(","))):
+            k, _, v = kv.partition("=")
+            if k == "shards":
+                kwargs[k] = tuple(int(s) for s in v.split("|") if s)
+            else:
+                kwargs[k] = _coerce(v)
+        arm(site.strip(), **kwargs)
+
+
+_env = os.environ.get(FAULTS_ENV)
+if _env:  # pragma: no cover - exercised via subprocess in CI degraded jobs
+    _parse_env(_env)

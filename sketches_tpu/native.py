@@ -18,17 +18,34 @@ import math
 import os
 import subprocess
 import threading
+import time
 import typing
 
 import numpy as np
 
-__all__ = ["available", "NativeDDSketch"]
+from sketches_tpu import faults, resilience
+from sketches_tpu.resilience import EngineUnavailable, SpecError
+
+__all__ = ["available", "reset", "NativeDDSketch", "NATIVE_ENV"]
+
+#: Environment kill switch: ``SKETCHES_TPU_NATIVE=0`` forces the native
+#: engine unavailable (pure-Python host tier), for degraded-mode CI and
+#: for operating around a broken toolchain without a code change.
+NATIVE_ENV = "SKETCHES_TPU_NATIVE"
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libddsketch_host.so")
 _lock = threading.Lock()
 _lib: typing.Optional[ctypes.CDLL] = None
 _build_error: typing.Optional[str] = None
+
+#: Build/load attempts before the engine degrades for the process, and
+#: the capped exponential backoff between them.  Retries cover transient
+#: failures (NFS hiccough on the .so, a concurrent build holding the
+#: file); a hard toolchain absence just fails fast three times.
+_MAX_LOAD_ATTEMPTS = 3
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 0.2
 
 
 _SRC_PATH = os.path.join(_NATIVE_DIR, "ddsketch_host.cpp")
@@ -49,59 +66,110 @@ def _stale() -> bool:
 
 
 def _load() -> typing.Optional[ctypes.CDLL]:
-    """Build (once, if needed) and load the shared library."""
+    """Build (if needed) and load the shared library, with bounded retry.
+
+    Transient failures (injected or real) retry up to
+    ``_MAX_LOAD_ATTEMPTS`` times with capped exponential backoff; a
+    still-failing load then degrades the process to the pure-Python host
+    tier -- cached (no per-call rebuild storms), observable as a
+    ``native -> python`` downgrade in ``resilience.health()``, and
+    clearable with :func:`reset`.
+    """
     global _lib, _build_error
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if _stale():
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    text=True,
+        if os.environ.get(NATIVE_ENV, "1") == "0":
+            _build_error = f"disabled via {NATIVE_ENV}=0"
+            resilience.record_downgrade(
+                "native", "native", "python", _build_error
+            )
+            return None
+        last_error = None
+        for attempt in range(_MAX_LOAD_ATTEMPTS):
+            if attempt:
+                time.sleep(
+                    min(_BACKOFF_BASE_S * 2 ** (attempt - 1), _BACKOFF_CAP_S)
                 )
-            except (OSError, subprocess.CalledProcessError) as e:
-                _build_error = getattr(e, "stderr", None) or str(e)
-                return None
-        lib = ctypes.CDLL(_LIB_PATH)
-        lib.sketch_create.restype = ctypes.c_void_p
-        lib.sketch_create.argtypes = [
-            ctypes.c_double,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-        ]
-        lib.sketch_destroy.argtypes = [ctypes.c_void_p]
-        lib.sketch_add.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
-        lib.sketch_add_batch.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.c_size_t,
-        ]
-        lib.sketch_quantile.restype = ctypes.c_double
-        lib.sketch_quantile.argtypes = [ctypes.c_void_p, ctypes.c_double]
-        lib.sketch_merge.restype = ctypes.c_int
-        lib.sketch_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
-        lib.sketch_counters.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        lib.sketch_bins.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        lib.sketch_load_bins.argtypes = [
-            ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double),
-        ]
-        _lib = lib
-        return _lib
+            try:
+                if faults._ACTIVE:
+                    faults.inject(faults.NATIVE_LOAD)
+                if _stale():
+                    subprocess.run(
+                        ["make", "-C", _NATIVE_DIR],
+                        check=True,
+                        capture_output=True,
+                        text=True,
+                    )
+                _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                return _lib
+            except (
+                OSError,
+                subprocess.CalledProcessError,
+                resilience.InjectedFault,
+            ) as e:
+                last_error = getattr(e, "stderr", None) or str(e)
+        _build_error = last_error or "unknown load failure"
+        resilience.record_downgrade(
+            "native",
+            "native",
+            "python",
+            f"load failed after {_MAX_LOAD_ATTEMPTS} attempts: {_build_error}",
+        )
+        return None
+
+
+def reset() -> None:
+    """Forget the cached load outcome (the next ``available()`` retries).
+
+    Test/ops hook: lets a process recover the native tier after the
+    condition behind a degradation (toolchain, env var, injected fault)
+    is fixed.  Live ``NativeDDSketch`` objects keep their own library
+    handle and are unaffected.
+    """
+    global _lib, _build_error
+    with _lock:
+        _lib = None
+        _build_error = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare the C ABI on a freshly loaded library handle."""
+    lib.sketch_create.restype = ctypes.c_void_p
+    lib.sketch_create.argtypes = [
+        ctypes.c_double,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+    ]
+    lib.sketch_destroy.argtypes = [ctypes.c_void_p]
+    lib.sketch_add.argtypes = [ctypes.c_void_p, ctypes.c_double, ctypes.c_double]
+    lib.sketch_add_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_size_t,
+    ]
+    lib.sketch_quantile.restype = ctypes.c_double
+    lib.sketch_quantile.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.sketch_merge.restype = ctypes.c_int
+    lib.sketch_merge.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.sketch_counters.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.sketch_bins.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.sketch_load_bins.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    return lib
 
 
 def available() -> bool:
@@ -141,13 +209,13 @@ class NativeDDSketch:
     ):
         lib = _load()
         if lib is None:
-            raise RuntimeError(
+            raise EngineUnavailable(
                 f"native engine unavailable: {_build_error or 'no toolchain'}"
             )
         if key_offset is None:
             key_offset = -(n_bins // 2)
         if mapping not in _MAPPING_KINDS:
-            raise ValueError(
+            raise SpecError(
                 f"Unknown mapping {mapping!r}; expected one of"
                 f" {sorted(_MAPPING_KINDS)}"
             )
@@ -156,7 +224,7 @@ class NativeDDSketch:
             relative_accuracy, n_bins, key_offset, _MAPPING_KINDS[mapping]
         )
         if not self._handle:
-            raise ValueError("invalid sketch parameters")
+            raise SpecError("invalid sketch parameters")
         self.relative_accuracy = relative_accuracy
         self.n_bins = n_bins
         self.key_offset = key_offset
@@ -165,15 +233,19 @@ class NativeDDSketch:
         self.gamma = 1.0 + mantissa
 
     def __del__(self):
+        # Finalizer-safe against partially-initialized objects: a ctor
+        # failure (unavailable engine, bad mapping, injected fault) can
+        # leave _handle and/or _lib unset, and __del__ still runs.
         handle = getattr(self, "_handle", None)
-        if handle:
-            self._lib.sketch_destroy(handle)
+        lib = getattr(self, "_lib", None)
+        if handle and lib is not None:
+            lib.sketch_destroy(handle)
             self._handle = None
 
     # -- core API ----------------------------------------------------------
     def add(self, val: float, weight: float = 1.0) -> None:
         if weight <= 0.0:
-            raise ValueError("weight must be positive")
+            raise resilience.SketchValueError("weight must be positive")
         self._lib.sketch_add(self._handle, float(val), float(weight))
 
     def add_batch(

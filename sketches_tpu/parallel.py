@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sketches_tpu import faults, resilience
 from sketches_tpu.batched import (
     BatchedDDSketch,
     SketchSpec,
@@ -42,6 +43,12 @@ from sketches_tpu.batched import (
     merge,
     quantile,
     recenter,
+)
+from sketches_tpu.resilience import (
+    ShardLossError,
+    ShardLossReport,
+    SketchValueError,
+    SpecError,
 )
 
 try:  # jax >= 0.6 exposes shard_map at top level
@@ -72,8 +79,72 @@ __all__ = [
     "make_global_mesh",
     "shard_streams",
     "psum_merge",
+    "fold_live_partials",
     "DistributedDDSketch",
 ]
+
+
+# ---------------------------------------------------------------------------
+# Lost-shard recovery: liveness-masked partial fold
+# ---------------------------------------------------------------------------
+
+_LIVE_FOLD_JITS: dict = {}
+
+
+def fold_live_partials(
+    spec: SketchSpec, partials: SketchState, live
+) -> SketchState:
+    """Fold a stacked ``[K, n_streams, ...]`` partials pytree over its
+    shard axis, counting only the shards where ``live[k]`` is True.
+
+    Because every partial is itself an exact sketch (full mergeability --
+    the property the whole recovery story leans on), the result is an
+    EXACT sketch of the surviving shards' mass: quantiles of the
+    survivors, not an approximation of the full stream.  Dead shards'
+    slices contribute the fold identities (zero mass, +-inf extrema,
+    empty-span sentinels), exactly as if those shards had never ingested.
+
+    ``live`` is a ``[K]`` boolean mask (host or device).  The mask is
+    *traced*, so one compilation serves every liveness pattern.
+    """
+    fn = _LIVE_FOLD_JITS.get(spec)
+    if fn is None:
+
+        def body(p: SketchState, lv: jax.Array) -> SketchState:
+            l2 = lv[:, None, None]
+            l1 = lv[:, None]
+            msum2 = lambda x: jnp.where(l2, x, 0).sum(0)
+            msum1 = lambda x: jnp.where(l1, x, 0).sum(0)
+            i32min = jnp.iinfo(jnp.int32).min
+            return SketchState(
+                bins_pos=msum2(p.bins_pos),
+                bins_neg=msum2(p.bins_neg),
+                zero_count=msum1(p.zero_count),
+                count=msum1(p.count),
+                sum=msum1(p.sum),
+                min=jnp.where(l1, p.min, jnp.inf).min(0),
+                max=jnp.where(l1, p.max, -jnp.inf).max(0),
+                collapsed_low=msum1(p.collapsed_low),
+                collapsed_high=msum1(p.collapsed_high),
+                # Offsets are identical on every partial (the equal-offsets
+                # invariant); the masked max picks any live shard's.
+                key_offset=jnp.where(l1, p.key_offset, i32min)
+                .max(0)
+                .astype(jnp.int32),
+                pos_lo=jnp.where(l1, p.pos_lo, spec.n_bins)
+                .min(0)
+                .astype(jnp.int32),
+                pos_hi=jnp.where(l1, p.pos_hi, -1).max(0).astype(jnp.int32),
+                neg_lo=jnp.where(l1, p.neg_lo, spec.n_bins)
+                .min(0)
+                .astype(jnp.int32),
+                neg_hi=jnp.where(l1, p.neg_hi, -1).max(0).astype(jnp.int32),
+                neg_total=msum1(p.neg_total),
+                tile_sums=msum2(p.tile_sums),
+            )
+
+        fn = _LIVE_FOLD_JITS[spec] = jax.jit(body)
+    return fn(partials, jnp.asarray(live, bool))
 
 
 def default_mesh(
@@ -223,7 +294,7 @@ class DistributedDDSketch:
         if mesh is None:
             default_axis = value_axis or stream_axis
             if default_axis is None:
-                raise ValueError(
+                raise SpecError(
                     "Need at least one of value_axis / stream_axis (or pass"
                     " an explicit mesh)"
                 )
@@ -245,7 +316,7 @@ class DistributedDDSketch:
         divisible = n_streams % n_stream_shards == 0
         n_local_streams = n_streams // n_stream_shards
         if engine == "pallas" and not divisible:
-            raise ValueError(
+            raise SpecError(
                 f"engine='pallas' needs a whole per-shard stream count:"
                 f" n_streams={n_streams} is not divisible by the"
                 f" {n_stream_shards}-way {stream_axis!r} mesh axis"
@@ -428,6 +499,10 @@ class DistributedDDSketch:
         # windowed-XLA path: integer compare, exact past 2**24.
         self._pallas_query = use_pallas and not spec.bins_integer
         self._wxla_ok = spec.n_bins % 128 == 0
+        # Engine-health ladder state (mirrors BatchedDDSketch): tiers this
+        # facade demoted away from after a lowering/compile failure.
+        self._query_disabled: set = set()
+        self._health_component = "distributed"
         self._windowed_jits = {}
         self._tiles_jits = {}
         self._overlap_jits = {}
@@ -480,7 +555,7 @@ class DistributedDDSketch:
         if values.ndim == 1:
             values = values[:, None]
         if values.shape[-1] % self.n_value_shards:
-            raise ValueError(
+            raise SketchValueError(
                 f"values width {values.shape[-1]} must be divisible by the"
                 f" {self.n_value_shards}-way {self.value_axis!r} mesh axis;"
                 " pad with weights=0 entries"
@@ -544,18 +619,83 @@ class DistributedDDSketch:
             self._merged_cache = self._fold(self.partials)
         return self._merged_cache
 
+    def merge_partial(self, live_mask=None):
+        """Fold only the LIVE value-shards' partials -> ``(state, report)``.
+
+        The lost-shard recovery primitive: with ``k`` of ``K`` value
+        shards dead, the fold of the surviving ``K - k`` partials is an
+        *exact* sketch of every value those shards ingested (each partial
+        is itself a sketch -- mergeability is what buys the recovery),
+        and the :class:`~sketches_tpu.resilience.ShardLossReport` carries
+        the per-stream dropped mass and fraction.  Quantiles of the
+        result are exact-contract answers over the surviving mass.
+
+        ``live_mask`` is a ``[n_value_shards]`` boolean; ``None`` derives
+        it from the fault harness's armed ``mesh.shard`` site (the
+        simulation hook) and defaults to all-live.  At least one shard
+        must survive (:class:`ShardLossError` otherwise).  Dropped-mass
+        accounting reads the dead partials' counters, which is possible
+        in simulation/post-mortem; a fold after a REAL device loss should
+        pass the mask explicitly and treat ``report.dropped_count`` as
+        best-effort (see the report's docstring).
+        """
+        k = self.n_value_shards
+        if live_mask is None:
+            live = np.ones((k,), bool)
+            dead = faults.dead_shards(k)
+            if dead:
+                live[list(dead)] = False
+        else:
+            live = np.asarray(live_mask, bool).reshape(-1)
+            if live.shape[0] != k:
+                raise SketchValueError(
+                    f"live_mask length {live.shape[0]} != n_value_shards {k}"
+                )
+        if not live.any():
+            raise ShardLossError(
+                f"all {k} value shards marked dead; nothing to fold"
+            )
+        survived = fold_live_partials(self.spec, self.partials, live)
+        full_count = np.asarray(
+            jax.device_get(self.partials.count), np.float64
+        ).sum(axis=0)
+        surviving_count = np.asarray(
+            jax.device_get(survived.count), np.float64
+        )
+        report = ShardLossReport(
+            live=live,
+            surviving_count=surviving_count,
+            dropped_count=full_count - surviving_count,
+        )
+        if report.n_dead:
+            resilience.bump("mesh.dead_shards", report.n_dead)
+            resilience.record_downgrade(
+                f"{self._health_component}.mesh",
+                f"{k} value shards",
+                f"{int(live.sum())} value shards",
+                f"dead shards {report.dead_shards}; dropped"
+                f" {report.total_dropped_fraction:.4f} of total mass",
+            )
+        return survived, report
+
     def _invalidate_plans(self) -> None:
         self._window_plan = None
         self._tile_plans = {}
 
     def _query_fn(self, qs_tuple: tuple):
-        """Per-shard query dispatch (engine ladder -- see ``__init__``)."""
+        """The dispatched query callable (engine ladder in ``__init__``)."""
+        return self._query_choice(qs_tuple)[1]
+
+    def _query_choice(self, qs_tuple: tuple):
+        """Per-shard query dispatch -> ``(tier, fn)`` (engine ladder --
+        see ``__init__``; ``tier`` names the resilience ladder rung)."""
         from sketches_tpu import kernels
 
         spec = self.spec
         interpret = self._interpret
         q_total = len(qs_tuple)
-        if self._pallas_query:
+        disabled = self._query_disabled
+        if self._pallas_query and "windowed" not in disabled:
             n_local = self._n_local_streams
             if self._window_plan is None:
                 self._window_plan = kernels.plan_state_window(
@@ -565,8 +705,12 @@ class DistributedDDSketch:
             # Eligibility and engine choice shared with BatchedDDSketch via
             # kernels.tile_query_eligible / choose_query_engine (the one
             # home of the policy -- ADVICE r4).
-            if n_local and kernels.tile_query_eligible(
-                spec, q_total, self._window_plan
+            if (
+                n_local
+                and "tiles" not in disabled
+                and kernels.tile_query_eligible(
+                    spec, q_total, self._window_plan
+                )
             ):
                 bn = kernels._stream_block(n_local)
                 plan = self._tile_plans.get(qs_tuple)
@@ -582,7 +726,8 @@ class DistributedDDSketch:
                 k_tiles, with_neg_t = plan
                 pick = kernels.choose_query_engine(
                     self._window_plan, plan,
-                    overlap_ok=kernels.overlap_enabled(),
+                    overlap_ok=kernels.overlap_enabled()
+                    and "overlap" not in disabled,
                 )
                 if pick == "overlap":
                     key = (k_tiles, with_neg_t, q_total)
@@ -605,7 +750,7 @@ class DistributedDDSketch:
                             )
                         )
                         self._overlap_jits[key] = fn
-                    return fn
+                    return ("overlap", fn)
                 if pick == "tiles":
                     key = (k_tiles, with_neg_t, q_total)
                     fn = self._tiles_jits.get(key)
@@ -627,7 +772,7 @@ class DistributedDDSketch:
                             )
                         )
                         self._tiles_jits[key] = fn
-                    return fn
+                    return ("tiles", fn)
             key = (n_w, w_t, with_neg, q_total)
             fn = self._windowed_jits.get(key)
             if fn is None:
@@ -650,8 +795,8 @@ class DistributedDDSketch:
                 )
                 self._windowed_jits[key] = fn
             lo_arr = jnp.asarray([lo_w], jnp.int32)
-            return lambda state, qs: fn(state, qs, lo_arr)
-        if self._wxla_ok:
+            return ("windowed", lambda state, qs: fn(state, qs, lo_arr))
+        if self._wxla_ok and "wxla" not in disabled:
             # Pure-XLA occupied-window walk: jit sharding propagation keeps
             # it shard-local (the slice is along the bin axis, which is
             # never sharded), no shard_map needed.
@@ -674,17 +819,33 @@ class DistributedDDSketch:
                 )
                 self._wxla_jits[key] = fn
             lo_tile = lo_w * w_t
-            return lambda state, qs: fn(state, qs, lo_tile)
-        return self._quantile
+            return ("wxla", lambda state, qs: fn(state, qs, lo_tile))
+        return ("xla", self._quantile)
+
+    def _run_query(self, qs_tuple: tuple, qs_arr: jax.Array) -> jax.Array:
+        """Dispatch down the engine ladder, degrading on failure (mirrors
+        ``BatchedDDSketch._run_query``; queries fold but never mutate the
+        partials, so a retry on the next tier is always sound)."""
+        while True:
+            tier, fn = self._query_choice(qs_tuple)
+            try:
+                if faults._ACTIVE:
+                    faults.inject(faults.PALLAS_LOWERING, tier=tier)
+                return fn(self.merged_state(), qs_arr)
+            except Exception as e:
+                nxt = resilience.demote_query_tier(self._query_disabled, tier)
+                if nxt is None:
+                    raise
+                resilience.record_downgrade(
+                    f"{self._health_component}.query", tier, nxt, repr(e)
+                )
 
     def get_quantile_value(self, q: float) -> jax.Array:
-        return self._query_fn((float(q),))(
-            self.merged_state(), jnp.asarray([q])
-        )[:, 0]
+        return self._run_query((float(q),), jnp.asarray([q]))[:, 0]
 
     def get_quantile_values(self, qs: Sequence[float]) -> jax.Array:
         qs = [float(q) for q in qs]
-        return self._query_fn(tuple(qs))(self.merged_state(), jnp.asarray(qs))
+        return self._run_query(tuple(qs), jnp.asarray(qs))
 
     def merge(self, other: "DistributedDDSketch") -> "DistributedDDSketch":
         """Fold another distributed batch into this one.
@@ -800,6 +961,7 @@ class DistributedDDSketch:
         value_axis: Optional[str] = "values",
         stream_axis: Optional[str] = None,
         engine: str = "auto",
+        live_mask=None,
     ) -> "DistributedDDSketch":
         """Build a mesh-sharded facade holding a FOLDED batch (the inverse
         of ``merged_state`` -- checkpoint resume, ``to_batched`` undo).
@@ -812,8 +974,39 @@ class DistributedDDSketch:
         folds it with pmax under that invariant), so the loaded
         per-stream offsets broadcast to all shards.  The mesh/axes may
         differ from wherever the state came from -- it is topology-free.
+
+        Lost-shard resume: with ``live_mask`` (a ``[K]`` boolean),
+        ``state`` must instead be a STACKED ``[K, n_streams, ...]``
+        partials pytree; the live shards fold via
+        :func:`fold_live_partials` (an exact sketch of the surviving
+        mass, dead shards recorded in ``resilience.health()``) and the
+        fold loads as above.
         """
         import dataclasses
+
+        if live_mask is not None:
+            live = np.asarray(live_mask, bool).reshape(-1)
+            if state.bins_pos.ndim != 3 or state.bins_pos.shape[0] != live.shape[0]:
+                raise SketchValueError(
+                    "live_mask requires a stacked [K, n_streams, n_bins]"
+                    f" partials state with K == len(live_mask) =="
+                    f" {live.shape[0]}; got bins of shape"
+                    f" {tuple(state.bins_pos.shape)}"
+                )
+            if not live.any():
+                raise ShardLossError(
+                    "all partials marked dead; nothing to restore"
+                )
+            state = fold_live_partials(spec, state, live)
+            if not live.all():
+                resilience.bump("mesh.dead_shards", int((~live).sum()))
+                resilience.record_downgrade(
+                    "distributed.mesh",
+                    f"{live.shape[0]} partials",
+                    f"{int(live.sum())} partials",
+                    "from_merged_state restored with dead partials"
+                    f" {[int(i) for i in np.nonzero(~live)[0]]}",
+                )
 
         dist = cls(
             state.n_streams,

@@ -37,10 +37,11 @@ decode unconditionally.
 from __future__ import annotations
 
 import struct
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
+from sketches_tpu import faults, resilience
 from sketches_tpu.batched import (
     SketchSpec,
     SketchState,
@@ -48,6 +49,12 @@ from sketches_tpu.batched import (
     occupied_bounds_np,
 )
 from sketches_tpu.pb import ddsketch_pb2 as pb
+from sketches_tpu.resilience import (
+    BlobTooLarge,
+    QuarantineReport,
+    SketchValueError,
+    UnequalSketchParametersError,
+)
 
 __all__ = ["state_to_bytes", "bytes_to_state", "protos_to_state"]
 
@@ -541,12 +548,27 @@ class _Template:
         return pending, zc
 
 
+def _quarantine_kind(exc: BaseException) -> str:
+    """Stable reason slug for one quarantined blob's failure."""
+    if isinstance(exc, BlobTooLarge):
+        return "over_limit"
+    if isinstance(exc, UnequalSketchParametersError):
+        return "mapping_mismatch"
+    if type(exc).__name__ == "DecodeError":  # google.protobuf DecodeError
+        return "unparseable"
+    if isinstance(exc, ValueError):
+        return "invalid"
+    return "error"
+
+
 def bytes_to_state(
     spec: SketchSpec,
     blobs: Sequence[bytes],
     *,
     assume_native_linear: bool = False,
-) -> SketchState:
+    errors: str = "raise",
+    max_blob_bytes: Optional[int] = None,
+):
     """Decode raw wire blobs into one device batch.
 
     Canonical blobs (this library's own encoder shape: expected mapping
@@ -555,9 +577,34 @@ def bytes_to_state(
     per-message to the C++ parser + careful placement, so foreign wire
     quirks (sparse maps, unpacked doubles, unknown fields) decode with the
     object bridge's exact semantics.
+
+    Error policy (r7 quarantine contract):
+
+    * ``errors="raise"`` (default): the pre-r7 behavior -- the first bad
+      blob raises (protobuf ``DecodeError``, mapping-gate ``ValueError``,
+      :class:`BlobTooLarge`) and the whole batch is lost.
+    * ``errors="quarantine"``: returns ``(state, QuarantineReport)``.
+      Bad blobs -- unparseable bytes, mapping mismatches/refusals, blobs
+      over ``max_blob_bytes`` -- are isolated into the report (index +
+      structured reason) and decode as EMPTY streams; every other stream
+      decodes **bit-identically** to a clean decode of the same blob
+      (quarantine changes admission, never placement).  The failure
+      counts also land in ``resilience.health()``'s counters.  Limit of
+      the contract: corruption that yields *structurally valid* protobuf
+      is undetectable (the wire format carries no checksum) -- it decodes
+      as whatever sketch the bytes describe.
+
+    ``max_blob_bytes`` is the admission cap against oversized/hostile
+    blobs (``None`` = no cap); it applies in both error modes.
     """
     from sketches_tpu.mapping import LinearlyInterpolatedMapping
 
+    if errors not in ("raise", "quarantine"):
+        raise SketchValueError(
+            f"Unknown errors mode {errors!r}; expected 'raise' or"
+            " 'quarantine'"
+        )
+    report = QuarantineReport(total=len(blobs)) if errors == "quarantine" else None
     dec = _Decoder(spec, len(blobs))
     expected_mapping = _mapping_field(spec)
     mlen = len(expected_mapping)
@@ -573,6 +620,19 @@ def bytes_to_state(
     zeros: list = []  # (stream, zeroCount) -- vector-assigned at the end
     templates: dict = {}  # blob length -> _Template
     for i, blob in enumerate(blobs):
+        if faults._ACTIVE:
+            # Injected blob corruption (deterministic per index) -- the
+            # quarantine path must then catch what it produces.
+            blob = faults.inject(faults.WIRE_BLOB, payload=blob, index=i)
+        if max_blob_bytes is not None and len(blob) > max_blob_bytes:
+            exc = BlobTooLarge(
+                f"blob {i}: {len(blob)} bytes exceeds"
+                f" max_blob_bytes={max_blob_bytes}"
+            )
+            if report is None:
+                raise exc
+            report.add(i, "over_limit", exc)
+            continue
         parsed = None
         if fast_ok and blob.startswith(expected_mapping):
             t = templates.get(len(blob))
@@ -594,9 +654,21 @@ def bytes_to_state(
                             blob, mlen, positions, zc_pos
                         )
         if parsed is None:
-            dec.careful_message(
-                i, pb.DDSketch.FromString(blob), assume_native_linear
-            )
+            if report is None:
+                dec.careful_message(
+                    i, pb.DDSketch.FromString(blob), assume_native_linear
+                )
+            else:
+                # Quarantine admission: every raiser on this path
+                # (FromString's DecodeError, the mapping gates) fires
+                # BEFORE any placement into the decode arrays, so a
+                # quarantined stream's row stays exactly empty.
+                try:
+                    dec.careful_message(
+                        i, pb.DDSketch.FromString(blob), assume_native_linear
+                    )
+                except Exception as e:
+                    report.add(i, _quarantine_kind(e), e)
             continue
         pending, zc = parsed
         groups = dec.groups
@@ -615,7 +687,13 @@ def bytes_to_state(
         zv = np.fromiter((z[1] for z in zeros), np.float64, len(zeros))
         dec.zero[zi] = zv
         dec.count[zi] += zv
-    return dec.finish()
+    if report is None:
+        return dec.finish()
+    if report.n_quarantined:
+        resilience.bump("wire.quarantined", report.n_quarantined)
+        for kind, n in report.counters.items():
+            resilience.bump(f"wire.quarantined.{kind}", n)
+    return dec.finish(), report
 
 
 def protos_to_state(
@@ -623,14 +701,19 @@ def protos_to_state(
     protos: Sequence["pb.DDSketch"],
     *,
     assume_native_linear: bool = False,
-) -> SketchState:
+    errors: str = "raise",
+    max_blob_bytes: Optional[int] = None,
+):
     """Decode parsed messages into one device batch.
 
     Re-serializing through the C++ serializer (~1 us/message) canonicalizes
-    the wire, so the group-vectorized bytes path serves message inputs too.
+    the wire, so the group-vectorized bytes path serves message inputs too
+    (error policy included -- see :func:`bytes_to_state`).
     """
     return bytes_to_state(
         spec,
         [m.SerializeToString() for m in protos],
         assume_native_linear=assume_native_linear,
+        errors=errors,
+        max_blob_bytes=max_blob_bytes,
     )

@@ -1,0 +1,332 @@
+"""Resilience layer: error taxonomy, degradation ladder, loss accounting.
+
+The serving story (ROADMAP north star: heavy traffic, partial
+infrastructure loss) needs every failure path to be a *degradation* path,
+not a crash path.  DDSketch's full mergeability (PAPER.md) is what makes
+that possible -- any subset of blobs/shards/partials is itself an exact
+sketch of the mass it holds -- so the recovery primitives here are all
+"keep the survivors, account for the rest":
+
+* **Error taxonomy** (:class:`SketchError` and friends): one structured
+  hierarchy replacing the ad-hoc ``ValueError``/``RuntimeError`` raises
+  across the modules.  Every class keeps its legacy base (``ValueError``
+  or ``RuntimeError``) so existing callers' ``except`` clauses -- and the
+  pre-r7 test suite -- keep working unchanged.
+* **Health registry** (:func:`record_downgrade` / :func:`health`): the
+  process-wide ledger of every degradation any component took (engine
+  ladder steps, native-tier loss, quarantined blobs, dead shards).  A
+  downgrade is never silent: callers that survive a failure MUST record
+  it here, and :func:`health` is the one snapshot an operator polls.
+* **Reports** (:class:`QuarantineReport`, :class:`ShardLossReport`): the
+  structured accounting objects the quarantine decode
+  (``pb.wire.bytes_to_state(errors="quarantine")``) and the lost-shard
+  fold (``parallel.DistributedDDSketch.merge_partial``) hand back.
+
+Ladder semantics (docs/DESIGN.md section 8): the query engines degrade
+``overlap -> tiles -> windowed -> wxla -> xla`` (each step drops to the
+next-slower-but-simpler tier and is recorded); ingest degrades
+``pallas -> xla``; the host tier degrades ``native -> python``.  Every
+tier computes the same answer -- degradation costs latency, never
+correctness.
+
+This module imports nothing from the rest of the package (it sits below
+everything), so any module may import it without cycles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "SketchError",
+    "SketchValueError",
+    "SpecError",
+    "UnequalSketchParametersError",
+    "WireDecodeError",
+    "BlobTooLarge",
+    "CheckpointCorrupt",
+    "EngineUnavailable",
+    "ShardLossError",
+    "InjectedFault",
+    "QuarantineRecord",
+    "QuarantineReport",
+    "ShardLossReport",
+    "DowngradeEvent",
+    "record_downgrade",
+    "bump",
+    "health",
+    "reset",
+    "QUERY_LADDER",
+    "demote_query_tier",
+]
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+
+class SketchError(Exception):
+    """Base of every structured sketches_tpu error.
+
+    ``except SketchError`` catches everything this library raises on its
+    own behalf (fault-injected failures included); backend exceptions
+    (XLA compile errors, protobuf DecodeError) pass through untouched on
+    the paths that do not explicitly ladder over them.
+    """
+
+
+class SketchValueError(SketchError, ValueError):
+    """A caller handed the library an unusable value (bad weight, ragged
+    batch width, refused wire bytes).  Subclasses ``ValueError`` so
+    pre-taxonomy ``except ValueError`` call sites keep working."""
+
+
+class SpecError(SketchValueError):
+    """Invalid static configuration: sketch/spec constructor arguments,
+    mesh axes, engine names."""
+
+
+class UnequalSketchParametersError(SketchValueError):
+    """Raised when merging sketches whose mappings (gamma/offset) differ.
+
+    Lives here (taxonomy root) since r7; ``sketches_tpu.ddsketch``
+    re-exports it, so the historical import path keeps working.
+    """
+
+
+class WireDecodeError(SketchValueError):
+    """A wire blob failed the decode contract (structure, limits)."""
+
+
+class BlobTooLarge(WireDecodeError):
+    """A wire blob exceeds the caller's ``max_blob_bytes`` admission cap."""
+
+
+class CheckpointCorrupt(SketchError):
+    """A checkpoint failed restore validation: truncated file, bad
+    archive, checksum mismatch, or missing fields.  Deliberately NOT a
+    ``ValueError``: corruption is an integrity failure, not a bad
+    argument, and must not be swallowed by value-error handlers."""
+
+
+class EngineUnavailable(SketchError, RuntimeError):
+    """An execution engine cannot be used (native library failed to
+    build/load after retries, Pallas tier lost mid-stream).  Subclasses
+    ``RuntimeError`` for pre-taxonomy call sites."""
+
+
+class ShardLossError(SketchError):
+    """Unrecoverable shard loss: no live shard remains to fold."""
+
+
+class InjectedFault(SketchError):
+    """The deterministic failure raised by an armed ``faults`` site."""
+
+
+# ---------------------------------------------------------------------------
+# Health registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DowngradeEvent:
+    """One recorded degradation: ``component`` moved ``from_tier`` ->
+    ``to_tier`` because ``reason``."""
+
+    component: str
+    from_tier: str
+    to_tier: str
+    reason: str
+    time: float
+
+
+_lock = threading.Lock()
+_events: List[DowngradeEvent] = []
+_tiers: Dict[str, str] = {}
+_counters: Dict[str, float] = {}
+
+
+def record_downgrade(
+    component: str, from_tier: str, to_tier: str, reason: str = ""
+) -> DowngradeEvent:
+    """Record one degradation step into the process-wide health ledger."""
+    ev = DowngradeEvent(
+        component, from_tier, to_tier, str(reason)[:500], time.time()
+    )
+    with _lock:
+        _events.append(ev)
+        _tiers[component] = to_tier
+        _counters["downgrades"] = _counters.get("downgrades", 0) + 1
+    return ev
+
+
+def bump(name: str, n: float = 1) -> None:
+    """Increment a named health counter (quarantined blobs, fired faults,
+    dead shards, ...)."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def health() -> dict:
+    """Snapshot of the resilience ledger.
+
+    Returns ``{"tiers": {component: current tier}, "counters": {...},
+    "downgrades": [event dicts, oldest first]}``.  Empty maps mean no
+    component has degraded -- the healthy steady state.  The snapshot is
+    a deep copy; mutating it does not touch the ledger.
+    """
+    with _lock:
+        return {
+            "tiers": dict(_tiers),
+            "counters": dict(_counters),
+            "downgrades": [dataclasses.asdict(e) for e in _events],
+        }
+
+
+def reset() -> None:
+    """Clear the ledger (test isolation hook)."""
+    with _lock:
+        _events.clear()
+        _tiers.clear()
+        _counters.clear()
+
+
+# ---------------------------------------------------------------------------
+# Engine ladder bookkeeping
+# ---------------------------------------------------------------------------
+
+#: The query-engine degradation order, fastest first.  ``xla`` (the full
+#: portable quantile) is the floor and may not fail over further.
+QUERY_LADDER = ("overlap", "tiles", "windowed", "wxla", "xla")
+
+
+def demote_query_tier(disabled: set, tier: str) -> Optional[str]:
+    """Disable ``tier`` in a facade's ladder state -> the next tier label.
+
+    Returns ``None`` when ``tier`` is the floor (nothing left to fall
+    to -- the caller must re-raise).  A ``windowed`` failure disables the
+    whole Pallas query family: overlap/tiles build on the same lowering
+    machinery, so a windowed-tier lowering failure condemns them too.
+    """
+    if tier == "overlap":
+        disabled.add("overlap")
+        return "tiles"
+    if tier == "tiles":
+        disabled.add("tiles")
+        return "windowed"
+    if tier == "windowed":
+        disabled.update(("overlap", "tiles", "windowed"))
+        return "wxla"
+    if tier == "wxla":
+        disabled.add("wxla")
+        return "xla"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Quarantine accounting (bulk decode)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantineRecord:
+    """One quarantined blob: its batch index, a stable reason ``kind``
+    (``unparseable`` / ``mapping_mismatch`` / ``over_limit`` /
+    ``invalid`` / ``error``), the exception class name, and its message."""
+
+    index: int
+    kind: str
+    error: str
+    message: str
+
+
+@dataclasses.dataclass
+class QuarantineReport:
+    """Accounting for one quarantine-mode bulk decode.
+
+    ``records`` lists every quarantined blob (index + structured
+    reason), in batch order.  Quarantined streams decode as EMPTY rows
+    (zero mass) in the returned state; every other stream decodes
+    bit-identically to a clean decode of the same blob.
+    """
+
+    total: int
+    records: List[QuarantineRecord] = dataclasses.field(default_factory=list)
+
+    def add(self, index: int, kind: str, exc: BaseException) -> None:
+        self.records.append(
+            QuarantineRecord(index, kind, type(exc).__name__, str(exc)[:500])
+        )
+
+    @property
+    def indices(self) -> List[int]:
+        return [r.index for r in self.records]
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.records:
+            out[r.kind] = out.get(r.kind, 0) + 1
+        return out
+
+    @property
+    def n_quarantined(self) -> int:
+        return len(self.records)
+
+    @property
+    def n_ok(self) -> int:
+        return self.total - len(self.records)
+
+    def __bool__(self) -> bool:  # truthy iff anything was quarantined
+        return bool(self.records)
+
+
+# ---------------------------------------------------------------------------
+# Shard-loss accounting (distributed fold)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardLossReport:
+    """Accounting for a liveness-masked partial fold.
+
+    The folded state is an EXACT sketch of the surviving shards' mass
+    (mergeability: each partial is itself a sketch); this report says
+    what was left behind.  ``dropped_count`` is per-stream mass lost
+    with the dead shards -- derivable only while the dead partials are
+    still readable (simulation / post-mortem); a fold taken after a real
+    loss carries ``dropped_count=None`` and only the shard identities.
+    """
+
+    live: np.ndarray  # [K] bool
+    surviving_count: np.ndarray  # [N]
+    dropped_count: Optional[np.ndarray]  # [N], None if unknowable
+
+    @property
+    def dead_shards(self) -> List[int]:
+        return [int(i) for i in np.nonzero(~self.live)[0]]
+
+    @property
+    def n_dead(self) -> int:
+        return int((~self.live).sum())
+
+    @property
+    def dropped_fraction(self) -> Optional[np.ndarray]:
+        """Per-stream fraction of total mass lost with the dead shards."""
+        if self.dropped_count is None:
+            return None
+        total = self.surviving_count + self.dropped_count
+        return self.dropped_count / np.maximum(total, 1.0)
+
+    @property
+    def total_dropped_fraction(self) -> Optional[float]:
+        if self.dropped_count is None:
+            return None
+        total = float(self.surviving_count.sum() + self.dropped_count.sum())
+        return float(self.dropped_count.sum()) / max(total, 1.0)

@@ -369,7 +369,13 @@ def test_f32_accumulator_ceiling_is_exactly_2_pow_24():
 def test_f64_dtype_extends_exact_regime():
     import jax
 
-    with jax.enable_x64(True):
+    # jax >= 0.4.31 removed the jax.enable_x64 alias; the experimental
+    # context manager is the stable spelling across versions.
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+
+    with enable_x64(True):
         spec = SketchSpec(
             relative_accuracy=TEST_REL_ACC, n_bins=128, dtype=jnp.float64
         )

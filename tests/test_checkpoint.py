@@ -1,12 +1,20 @@
-"""Checkpoint / resume round-trips (SURVEY.md section 5, checkpoint row)."""
+"""Checkpoint / resume round-trips (SURVEY.md section 5, checkpoint row),
+plus the r7 durability contract: atomic tmp+rename writes and validated
+(checksummed) restores that raise CheckpointCorrupt instead of a numpy
+stack trace."""
+
+import os
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
+from sketches_tpu import faults
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec
 from sketches_tpu.checkpoint import restore, restore_state, save, save_state
 from sketches_tpu.parallel import DistributedDDSketch
+from sketches_tpu.resilience import CheckpointCorrupt, InjectedFault
 from tests.datasets import Lognormal
 
 
@@ -110,3 +118,89 @@ def test_restore_distributed_roundtrip(tmp_path):
                         method="lower")
     got_q = np.asarray(back.get_quantile_values([0.5]))[:, 0]
     assert np.all(np.abs(got_q - exact) <= 0.0101 * np.abs(exact))
+
+
+# ---------------------------------------------------------------------------
+# Durability contract (r7): atomic writes, validated restores
+# ---------------------------------------------------------------------------
+
+
+def _small_sketch():
+    sk = BatchedDDSketch(4, relative_accuracy=0.02, n_bins=128)
+    sk.add(
+        np.abs(np.random.RandomState(0).normal(5, 1, (4, 32))).astype(
+            np.float32
+        )
+    )
+    return sk
+
+
+def test_truncated_checkpoint_raises_checkpoint_corrupt(tmp_path):
+    """A torn/truncated file restores as a clear CheckpointCorrupt, not a
+    numpy/zipfile stack trace -- at every truncation point."""
+    sk = _small_sketch()
+    p = str(tmp_path / "ck.npz")
+    save(p, sk)
+    raw = open(p, "rb").read()
+    for cut in (10, 100, len(raw) // 2, len(raw) - 7):
+        open(p, "wb").write(raw[:cut])
+        with pytest.raises(CheckpointCorrupt):
+            restore_state(p)
+    # A missing file is NOT corruption: it stays FileNotFoundError.
+    with pytest.raises(FileNotFoundError):
+        restore_state(str(tmp_path / "never-written.npz"))
+
+
+def test_bit_corruption_raises_checkpoint_corrupt(tmp_path):
+    """Flipped content bytes fail the restore validation (zip CRC or the
+    content checksum) as CheckpointCorrupt."""
+    sk = _small_sketch()
+    p = str(tmp_path / "ck.npz")
+    save(p, sk)
+    raw = bytearray(open(p, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(CheckpointCorrupt):
+        restore_state(p)
+
+
+def test_atomic_write_survives_simulated_crash(tmp_path):
+    """A crash before the rename (injected) leaves the previous
+    checkpoint fully intact and no temp litter; a torn write (injected
+    truncation) never silently restores."""
+    sk = _small_sketch()
+    p = str(tmp_path / "ck.npz")
+    save(p, sk)
+    good = open(p, "rb").read()
+    try:
+        with faults.active({faults.CHECKPOINT_WRITE: dict(mode="raise")}):
+            with pytest.raises(InjectedFault):
+                save(p, sk)
+        assert open(p, "rb").read() == good  # old checkpoint untouched
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+        _, state = restore_state(p)
+        assert float(np.asarray(state.count).sum()) == 128.0
+        with faults.active({faults.CHECKPOINT_WRITE: dict(mode="truncate")}):
+            save(p, sk)  # torn bytes reach the final path
+        with pytest.raises(CheckpointCorrupt):
+            restore_state(p)
+    finally:
+        faults.disarm()
+
+
+def test_pre_checksum_checkpoints_still_restore(tmp_path):
+    """A checkpoint without the __checksum__ member (pre-r7 format)
+    restores unvalidated -- backward compatibility."""
+    import json
+    import zipfile
+
+    sk = _small_sketch()
+    p = str(tmp_path / "ck.npz")
+    save(p, sk)
+    legacy = str(tmp_path / "legacy.npz")
+    with zipfile.ZipFile(p) as zin, zipfile.ZipFile(legacy, "w") as zout:
+        for item in zin.namelist():
+            if "checksum" not in item:
+                zout.writestr(item, zin.read(item))
+    spec, state = restore_state(legacy)
+    assert float(np.asarray(state.count).sum()) == 128.0

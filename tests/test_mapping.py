@@ -143,7 +143,13 @@ def test_f64_array_path_under_x64():
     # forced f32 cast would garble keys for out-of-f32-range f64 values.
     import jax
 
-    with jax.enable_x64(True):
+    # jax >= 0.4.31 removed the jax.enable_x64 alias; the experimental
+    # context manager is the stable spelling across versions.
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+
+    with enable_x64(True):
         for name in (
             "linear_interpolated",
             "quadratic_interpolated",

@@ -225,11 +225,15 @@ def test_pallas_engine_distributed_matches_xla():
     )
 
 
-def test_overlap_engine_distributed_matches_xla():
+def test_overlap_engine_distributed_matches_xla(monkeypatch):
     """Mixed-sign data routes the distributed pallas facade to the overlap
     engine (manual DMA double buffering) per shard; results must match
     the XLA facade and the jit must be cached under the overlap ladder."""
     from jax.sharding import Mesh
+
+    from sketches_tpu import kernels
+
+    monkeypatch.setenv(kernels.OVERLAP_ENV, "1")  # pin against degraded CI
 
     mesh = Mesh(np.asarray(jax.devices()[:2]), ("streams",))
     kwargs = dict(mesh=mesh, value_axis=None, stream_axis="streams", spec=SPEC)

@@ -14,6 +14,10 @@ import math
 
 import numpy as np
 import pytest
+
+# Soft dependency: environments without hypothesis skip this module
+# cleanly instead of erroring at collection.
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from sketches_tpu import DDSketch

@@ -1,0 +1,497 @@
+"""Resilience layer under injected faults (ISSUE r7 acceptance suite).
+
+Demonstrates, with the deterministic harness in ``sketches_tpu.faults``:
+
+(a) quarantine bulk decode -- a 10k-blob batch with ~1% corrupt blobs
+    recovers 100% of the valid blobs bit-identically to a clean decode
+    and reports every corrupt index with a structured reason;
+(b) the engine ladder -- overlap -> tiles -> windowed -> wxla -> xla
+    (and native -> python) degrades without an exception escaping, each
+    downgrade visible in ``resilience.health()``;
+(c) a simulated dead mesh shard yields an exact merged sketch of the
+    surviving mass with the dropped fraction reported;
+plus the checkpoint durability contract (atomic writes, validated
+restores) and the structured error taxonomy.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sketches_tpu
+from sketches_tpu import faults, resilience
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, quantile
+from sketches_tpu.parallel import DistributedDDSketch, fold_live_partials
+from sketches_tpu.pb import wire
+from sketches_tpu.resilience import (
+    BlobTooLarge,
+    CheckpointCorrupt,
+    EngineUnavailable,
+    InjectedFault,
+    ShardLossError,
+    SketchError,
+    SketchValueError,
+    SpecError,
+    UnequalSketchParametersError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_harness():
+    """Every test starts disarmed with an empty health ledger."""
+    faults.disarm()
+    resilience.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+
+
+# ---------------------------------------------------------------------------
+# (a) Quarantine bulk decode
+# ---------------------------------------------------------------------------
+
+
+def _mixed_state(spec, n, seed=0):
+    sk = BatchedDDSketch(n, spec=spec)
+    rng = np.random.RandomState(seed)
+    v = (
+        rng.lognormal(0.0, 0.6, (n, 48))
+        * np.where(rng.rand(n, 48) < 0.25, -1.0, 1.0)
+        * (rng.rand(n, 48) > 0.1)
+    ).astype(np.float32)
+    sk.add(v)
+    return sk.state
+
+
+def test_quarantine_decode_10k_blobs_one_percent_corrupt():
+    """The headline acceptance case: 10k blobs, ~1% corrupted; every
+    valid blob decodes bit-identically to a clean decode, every corrupt
+    index is reported with a reason, corrupt streams stay empty."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    state = _mixed_state(spec, 10_000, seed=7)
+    blobs = wire.state_to_bytes(spec, state)
+    bad, corrupted = faults.corrupt_blobs(blobs, 0.01, seed=13)
+    assert 50 <= len(corrupted) <= 200  # ~1% of 10k, deterministic
+
+    got, report = wire.bytes_to_state(spec, bad, errors="quarantine")
+    assert report.indices == corrupted
+    assert report.n_quarantined == len(corrupted)
+    assert report.n_ok == 10_000 - len(corrupted)
+    for rec in report.records:
+        assert rec.kind == "unparseable" and rec.error and rec.message
+
+    clean = wire.bytes_to_state(spec, blobs)
+    ok = np.setdiff1d(np.arange(10_000), np.asarray(corrupted))
+    for field in ("bins_pos", "bins_neg", "zero_count", "count",
+                  "collapsed_low", "collapsed_high", "neg_total",
+                  "tile_sums"):
+        g = np.asarray(getattr(got, field))
+        c = np.asarray(getattr(clean, field))
+        np.testing.assert_array_equal(g[ok], c[ok], field)
+    # Quarantined streams decode as exactly-empty rows.
+    bad_rows = np.asarray(corrupted)
+    assert np.asarray(got.count)[bad_rows].sum() == 0
+    assert np.asarray(got.bins_pos)[bad_rows].sum() == 0
+    # ...and the counters surfaced in the process health ledger.
+    counters = resilience.health()["counters"]
+    assert counters["wire.quarantined"] == len(corrupted)
+    assert counters["wire.quarantined.unparseable"] == len(corrupted)
+
+
+def test_quarantine_reason_taxonomy():
+    """Each failure class lands under its own structured reason kind."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    state = _mixed_state(spec, 3, seed=3)
+    blobs = wire.state_to_bytes(spec, state)
+    # Foreign mapping: encode under a different alpha.
+    other = SketchSpec(relative_accuracy=0.05, n_bins=128)
+    foreign = wire.state_to_bytes(other, _mixed_state(other, 1, seed=4))
+    batch = [blobs[0], b"\xffgarbage", foreign[0], blobs[1] * 40, blobs[2]]
+    got, report = wire.bytes_to_state(
+        spec, batch, errors="quarantine",
+        max_blob_bytes=max(len(b) for b in blobs) + 64,
+    )
+    kinds = {r.index: r.kind for r in report.records}
+    assert kinds == {1: "unparseable", 2: "mapping_mismatch", 3: "over_limit"}
+    # The good blobs still decode bit-identically.
+    clean = wire.bytes_to_state(spec, [blobs[0], blobs[2]])
+    np.testing.assert_array_equal(
+        np.asarray(got.bins_pos)[[0, 4]], np.asarray(clean.bins_pos)
+    )
+
+
+def test_quarantine_via_armed_wire_site():
+    """The ``wire.blob`` injection site corrupts in-flight and the decode
+    quarantines exactly what the site's deterministic selection hit."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    state = _mixed_state(spec, 200, seed=9)
+    blobs = wire.state_to_bytes(spec, state)
+    _, expected = faults.corrupt_blobs(blobs, 0.05, seed=21)
+    assert expected  # the deterministic selection must hit something
+    with faults.active(
+        {faults.WIRE_BLOB: dict(mode="corrupt", fraction=0.05, seed=21)}
+    ):
+        got, report = wire.bytes_to_state(spec, blobs, errors="quarantine")
+    assert report.indices == expected
+
+
+def test_decode_raise_mode_unchanged():
+    """errors='raise' (the default) keeps the pre-r7 contract: first bad
+    blob raises; max_blob_bytes raises the structured BlobTooLarge."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    blobs = wire.state_to_bytes(spec, _mixed_state(spec, 2, seed=1))
+    with pytest.raises(Exception):
+        wire.bytes_to_state(spec, [b"\xff" + blobs[0][1:]])
+    with pytest.raises(BlobTooLarge):
+        wire.bytes_to_state(spec, blobs, max_blob_bytes=4)
+    with pytest.raises(SketchValueError, match="errors"):
+        wire.bytes_to_state(spec, blobs, errors="bogus")
+
+
+# ---------------------------------------------------------------------------
+# (b) Engine ladder
+# ---------------------------------------------------------------------------
+
+
+def _wide_mixed(n, s, seed=11):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.lognormal(0, 2.0, (n, s))
+        * np.where(rng.rand(n, s) < 0.3, -1.0, 1.0)
+    ).astype(np.float32)
+
+
+QS3 = [0.5, 0.9, 0.99]
+
+
+def test_batched_query_ladder_degrades_to_floor(monkeypatch):
+    """With every Pallas tier + wxla failing, the facade walks the whole
+    ladder overlap -> tiles -> windowed -> wxla -> xla on ONE query call,
+    returns the correct answer, and records each step."""
+    from sketches_tpu import kernels
+
+    monkeypatch.setenv(kernels.OVERLAP_ENV, "1")  # full ladder, even in degraded CI
+    sk = BatchedDDSketch(256, n_bins=512, engine="pallas")
+    data = _wide_mixed(256, 1024)
+    sk.add(data)
+    ref = np.asarray(quantile(sk.spec, sk.state, jnp.asarray(QS3)))
+    with faults.active(
+        {faults.PALLAS_LOWERING: dict(
+            tier=("overlap", "tiles", "windowed", "wxla")
+        )}
+    ):
+        got = np.asarray(sk.get_quantile_values(QS3))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    h = resilience.health()
+    steps = [
+        (e["from_tier"], e["to_tier"])
+        for e in h["downgrades"]
+        if e["component"] == "batched.query"
+    ]
+    assert steps == [
+        ("overlap", "tiles"),
+        ("tiles", "windowed"),
+        ("windowed", "wxla"),
+        ("wxla", "xla"),
+    ]
+    assert h["tiers"]["batched.query"] == "xla"
+    # The demotion sticks: later queries skip the dead tiers quietly.
+    got2 = np.asarray(sk.get_quantile_values(QS3))
+    np.testing.assert_allclose(got2, ref, rtol=1e-6, equal_nan=True)
+
+
+def test_batched_query_ladder_single_step(monkeypatch):
+    """An overlap-only failure falls exactly one rung (to the tile
+    engine) and stays there -- no over-demotion."""
+    from sketches_tpu import kernels
+
+    monkeypatch.setenv(kernels.OVERLAP_ENV, "1")
+    sk = BatchedDDSketch(256, n_bins=512, engine="pallas")
+    sk.add(_wide_mixed(256, 1024))
+    ref = np.asarray(quantile(sk.spec, sk.state, jnp.asarray(QS3)))
+    with faults.active({faults.PALLAS_LOWERING: dict(tier="overlap")}):
+        got = np.asarray(sk.get_quantile_values(QS3))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    assert sk._query_disabled == {"overlap"}
+    assert sk._tiles_jits  # the answer came off the tile engine
+    assert resilience.health()["tiers"]["batched.query"] == "tiles"
+    assert sk._query_choice(tuple(QS3))[0] == "tiles"
+
+
+def test_distributed_query_ladder_degrades():
+    """The distributed facade carries the same ladder over its shard_map
+    dispatch: injected lowering failures degrade to the portable path
+    without an exception escaping."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("values",))
+    dist = DistributedDDSketch(
+        256, mesh=mesh, value_axis="values", n_bins=512, engine="pallas"
+    )
+    data = _wide_mixed(256, 1024, seed=5)
+    dist.add(data)
+    ref = np.asarray(
+        quantile(dist.spec, dist.merged_state(), jnp.asarray(QS3))
+    )
+    with faults.active(
+        {faults.PALLAS_LOWERING: dict(
+            tier=("overlap", "tiles", "windowed", "wxla")
+        )}
+    ):
+        got = np.asarray(dist.get_quantile_values(QS3))
+    np.testing.assert_allclose(got, ref, rtol=1e-6, equal_nan=True)
+    assert resilience.health()["tiers"]["distributed.query"] == "xla"
+
+
+def test_batched_ingest_falls_back_to_xla():
+    """A Pallas ingest failure demotes to the XLA scatter path, replays
+    the batch (state stays exact), and records the downgrade."""
+    sk = BatchedDDSketch(256, n_bins=512, engine="pallas")
+    data = np.abs(_wide_mixed(256, 512, seed=3))
+    sk.add(data)  # first batch: auto-centering XLA path by design
+    ref = BatchedDDSketch(256, n_bins=512, engine="xla")
+    ref.add(data)
+    ref.add(data)
+    with faults.active({faults.PALLAS_INGEST: dict()}) as plans:
+        sk.add(data)
+    assert plans[faults.PALLAS_INGEST].fired == 1
+    assert sk._add_pallas is None  # demotion is permanent for the facade
+    np.testing.assert_array_equal(np.asarray(sk.count), np.asarray(ref.count))
+    np.testing.assert_allclose(
+        np.asarray(sk.get_quantile_values(QS3)),
+        np.asarray(ref.get_quantile_values(QS3)),
+        rtol=1e-6,
+    )
+    assert resilience.health()["tiers"]["batched.ingest"] == "xla"
+
+
+def test_native_load_retries_then_degrades():
+    """native._load retries transient failures with capped backoff and
+    degrades to the pure-Python tier (recorded) when the failure
+    persists; reset() re-arms the probe."""
+    from sketches_tpu import native
+
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    try:
+        # One transient failure: the retry recovers, no downgrade.
+        with faults.active({faults.NATIVE_LOAD: dict(times=1)}):
+            native.reset()
+            assert native.available()
+        assert "native" not in resilience.health()["tiers"]
+        # Persistent failure: all attempts consumed, engine degrades.
+        with faults.active({faults.NATIVE_LOAD: dict()}) as plans:
+            native.reset()
+            assert not native.available()
+            assert plans[faults.NATIVE_LOAD].fired == native._MAX_LOAD_ATTEMPTS
+        assert resilience.health()["tiers"]["native"] == "python"
+        with pytest.raises(EngineUnavailable):
+            native.NativeDDSketch(0.01)
+        # The host tier keeps serving: JaxDDSketch falls back to the
+        # device flush without the native buffer.
+        sk = sketches_tpu.JaxDDSketch(relative_accuracy=0.02, n_bins=128)
+        sk.add_many(np.asarray([1.0, 2.0, 3.0, 4.0]))
+        assert sk.count == 4.0
+        assert abs(sk.get_quantile_value(0.5) - 2.0) <= 0.05 * 2.0
+    finally:
+        native.reset()
+    assert native.available()
+
+
+def test_native_env_kill_switch(monkeypatch):
+    """SKETCHES_TPU_NATIVE=0 forces the pure-Python host tier (the CI
+    degraded-mode job's lever)."""
+    from sketches_tpu import native
+
+    monkeypatch.setenv(native.NATIVE_ENV, "0")
+    native.reset()
+    try:
+        assert not native.available()
+        assert resilience.health()["tiers"]["native"] == "python"
+    finally:
+        monkeypatch.delenv(native.NATIVE_ENV)
+        native.reset()
+
+
+def test_native_del_guard_partial_init():
+    """A NativeDDSketch finalizer on a partially-initialized object (ctor
+    failed before _handle/_lib were set) must not raise."""
+    from sketches_tpu import native
+
+    nd = native.NativeDDSketch.__new__(native.NativeDDSketch)
+    nd.__del__()  # no AttributeError
+    nd2 = native.NativeDDSketch.__new__(native.NativeDDSketch)
+    nd2._handle = None  # ctor failed right after create returned null
+    nd2.__del__()
+
+
+# ---------------------------------------------------------------------------
+# (c) Lost-shard recovery
+# ---------------------------------------------------------------------------
+
+
+def _dist_with_data(n_streams=8, width=64, seed=4):
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("values",))
+    dist = DistributedDDSketch(
+        n_streams, mesh=mesh, value_axis="values",
+        relative_accuracy=0.02, n_bins=256,
+    )
+    rng = np.random.RandomState(seed)
+    vals = (rng.lognormal(0.0, 0.5, (n_streams, width)) + 0.1).astype(
+        np.float32
+    )
+    dist.add(vals)
+    return dist, vals
+
+
+def test_merge_partial_exact_surviving_mass():
+    """Dropping one value shard folds the remaining partials into an
+    EXACT sketch of the surviving values: counts match the surviving
+    chunks exactly, quantiles hold the alpha contract against the
+    surviving values' oracle, dropped mass is accounted per stream."""
+    dist, vals = _dist_with_data()
+    k = dist.n_value_shards
+    chunk = vals.shape[1] // k
+    live = np.asarray([True, True, False, True])
+    survived, report = dist.merge_partial(live)
+    keep = np.concatenate(
+        [vals[:, i * chunk:(i + 1) * chunk] for i in range(k) if live[i]],
+        axis=1,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(survived.count), np.full(8, keep.shape[1], np.float32)
+    )
+    assert report.dead_shards == [2]
+    np.testing.assert_allclose(report.dropped_count, np.full(8, chunk))
+    np.testing.assert_allclose(
+        report.dropped_fraction, np.full(8, chunk / vals.shape[1])
+    )
+    assert report.total_dropped_fraction == pytest.approx(1 / k)
+    # Quantiles are exact-contract answers over the surviving values.
+    sk = BatchedDDSketch(8, spec=dist.spec, state=survived)
+    got = np.asarray(sk.get_quantile_values([0.25, 0.5, 0.9]))
+    for j, q in enumerate((0.25, 0.5, 0.9)):
+        exact = np.quantile(keep, q, axis=1, method="lower")
+        assert np.all(np.abs(got[:, j] - exact) <= 0.021 * np.abs(exact))
+    # The mass-conservation invariant holds on the folded state.
+    mass = (
+        np.asarray(survived.bins_pos).sum(-1)
+        + np.asarray(survived.bins_neg).sum(-1)
+        + np.asarray(survived.zero_count)
+    )
+    np.testing.assert_allclose(mass, np.asarray(survived.count))
+    # ...and the loss is in the health ledger.
+    h = resilience.health()
+    assert h["counters"]["mesh.dead_shards"] == 1
+    assert any(e["component"] == "distributed.mesh" for e in h["downgrades"])
+
+
+def test_merge_partial_fault_armed_and_guards():
+    """mesh.shard arming drives merge_partial with no explicit mask; an
+    all-dead mask is an explicit ShardLossError; an all-live fold equals
+    merged_state exactly."""
+    dist, _ = _dist_with_data(seed=6)
+    with faults.active({faults.MESH_SHARD: dict(shards=(1, 3))}):
+        survived, report = dist.merge_partial()
+    assert report.dead_shards == [1, 3]
+    assert report.total_dropped_fraction == pytest.approx(0.5)
+    with pytest.raises(ShardLossError):
+        dist.merge_partial(np.zeros(dist.n_value_shards, bool))
+    with pytest.raises(SketchValueError, match="live_mask"):
+        dist.merge_partial(np.ones(3, bool))
+    full, report_full = dist.merge_partial(np.ones(4, bool))
+    assert report_full.n_dead == 0
+    ref = dist.merged_state()
+    for f in ("bins_pos", "bins_neg", "count", "key_offset", "tile_sums"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(full, f)), np.asarray(getattr(ref, f)), f
+        )
+
+
+def test_from_merged_state_live_mask_resume():
+    """Resume from stacked partials with a shard lost: the restored
+    facade folds only the live partials and keeps working."""
+    dist, vals = _dist_with_data(seed=8)
+    k = dist.n_value_shards
+    chunk = vals.shape[1] // k
+    live = np.asarray([True, False, True, True])
+    partials = jax.tree.map(np.asarray, dist.partials)
+    back = DistributedDDSketch.from_merged_state(
+        partials, dist.spec, mesh=dist.mesh, value_axis="values",
+        live_mask=live,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.count), np.full(8, vals.shape[1] - chunk, np.float32)
+    )
+    # The resumed facade still ingests and queries.
+    back.add(np.full((8, 4), 2.0, np.float32))
+    assert float(np.asarray(back.count)[0]) == vals.shape[1] - chunk + 4
+    with pytest.raises(ShardLossError):
+        DistributedDDSketch.from_merged_state(
+            partials, dist.spec, mesh=dist.mesh, value_axis="values",
+            live_mask=np.zeros(k, bool),
+        )
+    with pytest.raises(SketchValueError, match="stacked"):
+        DistributedDDSketch.from_merged_state(
+            dist.merged_state(), dist.spec, mesh=dist.mesh,
+            value_axis="values", live_mask=live,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy + harness hygiene
+# ---------------------------------------------------------------------------
+
+
+def test_error_taxonomy_shape():
+    """The hierarchy keeps every legacy base class so pre-r7 handlers
+    (and tests) continue to catch what they caught."""
+    assert issubclass(UnequalSketchParametersError, SketchError)
+    assert issubclass(UnequalSketchParametersError, ValueError)
+    assert issubclass(SpecError, ValueError)
+    assert issubclass(SketchValueError, ValueError)
+    assert issubclass(BlobTooLarge, SketchValueError)
+    assert issubclass(EngineUnavailable, RuntimeError)
+    assert issubclass(InjectedFault, SketchError)
+    assert not issubclass(CheckpointCorrupt, ValueError)
+    with pytest.raises(SpecError):
+        SketchSpec(relative_accuracy=1.5)
+    with pytest.raises(SpecError):
+        SketchSpec(n_bins=1)
+    # The public package surface exports the taxonomy.
+    for name in ("SketchError", "CheckpointCorrupt", "QuarantineReport",
+                 "EngineUnavailable", "ShardLossReport"):
+        assert hasattr(sketches_tpu, name)
+
+
+def test_faults_disarmed_is_inert():
+    """Disarmed, the harness is a no-op passthrough (the zero-hot-path
+    cost contract) and unknown sites refuse to arm."""
+    assert not faults._ACTIVE
+    blob = b"payload"
+    assert faults.inject(faults.WIRE_BLOB, payload=blob, index=0) is blob
+    assert faults.dead_shards(8) == ()
+    with pytest.raises(ValueError, match="fault site"):
+        faults.arm("nonsense.site")
+    # Arm/disarm round-trips the flag.
+    faults.arm(faults.WIRE_BLOB, mode="corrupt", fraction=1.0)
+    assert faults._ACTIVE
+    faults.disarm()
+    assert not faults._ACTIVE
+
+
+def test_health_snapshot_isolated():
+    """health() returns a copy; mutating it cannot corrupt the ledger."""
+    resilience.record_downgrade("x", "a", "b", "r")
+    snap = resilience.health()
+    snap["tiers"]["x"] = "hacked"
+    snap["downgrades"].clear()
+    h = resilience.health()
+    assert h["tiers"]["x"] == "b"
+    assert len(h["downgrades"]) == 1

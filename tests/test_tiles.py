@@ -236,9 +236,10 @@ def test_facade_integer_routes_windowed_xla():
         assert np.all(np.abs(got[:, j] - exact) <= 0.0101 * exact + 1e-9)
 
 
-def test_facade_pallas_engine_ladder_dispatch():
+def test_facade_pallas_engine_ladder_dispatch(monkeypatch):
     """engine='pallas' facades answer through the plan-selected kernels
     with facade-level results matching the portable path."""
+    monkeypatch.setenv(kernels.OVERLAP_ENV, "1")  # pin against degraded CI
     sk = BatchedDDSketch(256, n_bins=512, engine="pallas")
     rng = np.random.RandomState(11)
     data = (
