@@ -29,7 +29,7 @@ if _SELF_PROVISIONED:
 
 import numpy as np
 
-from sketches_tpu import BatchedDDSketch, DDSketch
+from sketches_tpu import BatchedDDSketch
 from sketches_tpu.pb import (
     DDSketchProto,
     batched_from_bytes,

@@ -1,0 +1,45 @@
+"""sketchlint: the repo-specific static analyzer (docs/DESIGN.md section 9).
+
+Two layers, one CLI (``python -m sketches_tpu.analysis``, non-zero exit
+on violations):
+
+* **Layer 1 -- AST lint** (:mod:`~sketches_tpu.analysis.lint` +
+  ``analysis/rules/``): a small rule engine over ``ast`` encoding the
+  invariants the test suite can only sample -- the ``SketchError``
+  taxonomy, the kill-switch registry, the engine fallback ladder, the
+  f32-only device tier, deterministic hot paths, failure-mode
+  docstrings.
+* **Layer 2 -- jaxpr/lowering audit**
+  (:mod:`~sketches_tpu.analysis.jaxpr_audit`): trace every engine entry
+  point and verify what actually lowers -- no f64 ops, no host
+  callbacks, no weak-type scalar leaks, and a VMEM-budget check on the
+  overlap engine's DMA ring.
+
+This package also hosts the **kill-switch registry**
+(:mod:`~sketches_tpu.analysis.registry`): the single declared inventory
+of ``SKETCHES_TPU_*`` environment variables, which the production
+modules read at import time.  ``registry`` is therefore imported
+eagerly (it is stdlib-only and cycle-free); the analyzer layers load
+lazily so importing ``sketches_tpu`` never pays for them.
+
+Module-level failure story: the registry refuses undeclared variable
+names with ``KeyError``; the analyzer layers never raise on findings --
+violations are *returned* (and exit-coded by the CLI), and even a
+syntax error in a scanned file becomes a finding rather than an
+exception.
+"""
+
+from sketches_tpu.analysis import registry
+
+__all__ = ["registry", "lint", "jaxpr_audit"]
+
+
+def __getattr__(name):
+    # Lazy layer loading: `analysis.lint` / `analysis.jaxpr_audit` import
+    # on first attribute access, so `import sketches_tpu` (which pulls
+    # this package for the registry) stays free of analyzer weight.
+    if name in ("lint", "jaxpr_audit"):
+        import importlib
+
+        return importlib.import_module(f"sketches_tpu.analysis.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

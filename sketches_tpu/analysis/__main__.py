@@ -1,0 +1,147 @@
+"""CLI: ``python -m sketches_tpu.analysis`` -- run sketchlint, exit
+non-zero on violations.
+
+Default run (no arguments): AST lint + jaxpr audit over the installed
+``sketches_tpu`` package, findings filtered through the checked-in
+baseline (``analysis/baseline.json``), human-readable findings on
+stdout, exit 1 if anything non-baselined remains.  This is exactly what
+the CI ``static-analysis`` job runs on every push.
+
+Useful flags::
+
+    --no-jaxpr            AST layer only (fast; no jax import)
+    --json PATH           write the machine-readable report
+    --root PATH           lint a different package tree (fixture tests)
+    --rules a,b           run only the named rules
+    --baseline PATH       override the suppression file
+    --update-baseline     rewrite the baseline to suppress every current
+                          finding (then justify or fix each entry!)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from sketches_tpu.analysis import lint as lint_mod
+from sketches_tpu.analysis.lint import Finding
+
+
+def _default_root() -> str:
+    """The installed sketches_tpu package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv: List[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sketches_tpu.analysis",
+        description="sketchlint: AST invariant lint + jaxpr/lowering audit",
+    )
+    parser.add_argument(
+        "--root",
+        default=None,
+        help="package directory to lint (default: the installed"
+        " sketches_tpu); the jaxpr audit only runs on the default root",
+    )
+    parser.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="suppression file (default: <root>/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to suppress every current finding",
+    )
+    parser.add_argument(
+        "--no-jaxpr",
+        action="store_true",
+        help="skip the jaxpr/lowering audit (no jax import)",
+    )
+    parser.add_argument(
+        "--json", dest="json_path", default=None,
+        help="write the machine-readable JSON report here",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root or _default_root())
+    only = args.rules.split(",") if args.rules else None
+    baseline_path = args.baseline or os.path.join(
+        root, "analysis", "baseline.json"
+    )
+
+    findings = lint_mod.run_lint(root, only=only)
+
+    report = {
+        "root": root,
+        "layers": {"lint": True, "jaxpr": False},
+        "findings": [],
+        "jaxpr": None,
+    }
+    # The jaxpr audit traces the *imported* package, so it only means
+    # something when the linted root IS that package.
+    run_jaxpr = not args.no_jaxpr and root == _default_root()
+    if run_jaxpr:
+        from sketches_tpu.analysis import jaxpr_audit
+
+        jaxpr_findings, jaxpr_report = jaxpr_audit.audit()
+        findings.extend(jaxpr_findings)
+        report["layers"]["jaxpr"] = True
+        report["jaxpr"] = jaxpr_report
+
+    if args.update_baseline:
+        lint_mod.write_baseline(baseline_path, findings)
+        print(
+            f"baseline: wrote {len(findings)} suppression(s) to"
+            f" {baseline_path}"
+        )
+        return 0
+
+    baseline = lint_mod.load_baseline(baseline_path)
+    active = lint_mod.apply_baseline(findings, baseline)
+    suppressed = len(findings) - len(active)
+    stale = sorted(
+        set(baseline) - {f.fingerprint for f in findings}
+    )
+
+    report["findings"] = [f.to_dict() for f in findings]
+    report["baseline"] = {
+        "path": baseline_path,
+        "suppressed": suppressed,
+        "stale_fingerprints": stale,
+    }
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    for f in active:
+        print(f)
+    if stale:
+        print(
+            f"warning: {len(stale)} stale baseline entr"
+            f"{'y' if len(stale) == 1 else 'ies'} (fixed findings --"
+            " remove them): " + ", ".join(stale),
+            file=sys.stderr,
+        )
+    n_rules_note = f" ({suppressed} baselined)" if suppressed else ""
+    if active:
+        print(
+            f"sketchlint: {len(active)} violation(s){n_rules_note}",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"sketchlint: clean{n_rules_note}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

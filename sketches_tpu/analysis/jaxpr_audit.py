@@ -1,0 +1,312 @@
+"""sketchlint layer 2: trace the jitted/Pallas entry points and audit
+what actually lowers.
+
+The AST layer checks what the source *says*; this layer checks what the
+tracer *builds*.  Each registered entry point is traced with
+``jax.make_jaxpr`` under small abstract-shaped inputs (128 streams,
+256 bins -- tracing needs no TPU: Pallas calls abstract-eval on any
+backend), and the closed jaxpr is walked recursively for:
+
+* **f64 ops** (``jaxpr-f64``): any equation aval with a float64 /
+  complex128 dtype.  With x64 off these can't appear (jax demotes), but
+  the audit also runs in x64 contexts (multihost drivers), where an f64
+  leak silently de-optimizes the TPU path.
+* **host callbacks** (``jaxpr-callback``): ``pure_callback`` /
+  ``io_callback`` / debug-callback primitives inside a hot path -- each
+  execution would sync device->host.
+* **weak-type leaks** (``jaxpr-weak-type``): weak-typed *top-level*
+  inputs or outputs.  A weak input means a Python scalar reached the
+  traced boundary: the same call site recompiles when the scalar's
+  concrete type changes, and a weak output re-poisons the next stage's
+  cache key.
+* **trace failures** (``jaxpr-trace``): an entry point that no longer
+  traces under its documented signature is drift by definition.
+
+Separately, :func:`vmem_report` re-derives the overlap engine's VMEM
+ring footprint from the constants in ``kernels.py`` (ring depth x
+stream block x 128 lanes x 4 bytes, plus the rank slab and packed
+operands at the eligibility caps) and checks it against the declared
+:data:`VMEM_BUDGET_BYTES` -- the "kernels fit VMEM" convention,
+machine-checked (``vmem-budget``).
+
+Everything returns :class:`~sketches_tpu.analysis.lint.Finding` objects
+(layer ``"jaxpr"``) so the CLI, baseline, and JSON report treat both
+layers uniformly.  jax imports stay inside functions: importing this
+module is free, and the AST layer keeps working where jax is absent.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from sketches_tpu.analysis.lint import Finding
+
+__all__ = [
+    "VMEM_BUDGET_BYTES",
+    "audit",
+    "audit_callable",
+    "default_entry_points",
+    "vmem_report",
+]
+
+#: Per-core VMEM on the targeted TPU generations (v4/v5e: 16 MiB).  The
+#: audit requires the overlap ring + slab + operand blocks to fit WELL
+#: inside this -- Mosaic needs headroom for double-buffered operand
+#: blocks the automatic pipeline allocates.
+VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+
+_BAD_DTYPES = ("float64", "complex128")
+_CALLBACK_MARKERS = ("callback", "outside_call")
+
+
+def _iter_jaxprs(jaxpr) -> Iterable:
+    """The jaxpr and every sub-jaxpr reachable through eqn params."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for val in eqn.params.values():
+            for sub in _extract_jaxprs(val):
+                yield from _iter_jaxprs(sub)
+
+
+def _extract_jaxprs(val) -> Iterable:
+    import jax.core
+
+    if isinstance(val, jax.core.ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, jax.core.Jaxpr):
+        yield val
+    elif isinstance(val, (tuple, list)):
+        for item in val:
+            yield from _extract_jaxprs(item)
+
+
+def _aval_issues(aval) -> Optional[str]:
+    dtype = getattr(aval, "dtype", None)
+    if dtype is not None and str(dtype) in _BAD_DTYPES:
+        return str(dtype)
+    return None
+
+
+def audit_callable(
+    name: str, fn: Callable, args: Sequence, check_weak: bool = True
+) -> List[Finding]:
+    """Trace ``fn(*args)`` and audit the closed jaxpr.  Returns findings
+    (empty = clean); a trace failure is itself a finding, never a crash."""
+    import jax
+
+    path = f"<jaxpr:{name}>"
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 - any trace failure is the finding
+        return [
+            Finding(
+                "jaxpr-trace",
+                path,
+                0,
+                f"entry point {name} failed to trace: {type(e).__name__}:"
+                f" {str(e)[:300]}",
+                layer="jaxpr",
+            )
+        ]
+    findings: List[Finding] = []
+    jaxpr = closed.jaxpr
+    if check_weak:
+        for kind, vs in (("input", jaxpr.invars), ("output", jaxpr.outvars)):
+            for i, v in enumerate(vs):
+                aval = getattr(v, "aval", None)
+                if aval is not None and getattr(aval, "weak_type", False):
+                    findings.append(
+                        Finding(
+                            "jaxpr-weak-type",
+                            path,
+                            0,
+                            f"{name}: weak-typed {kind} #{i} ({aval}); a"
+                            " Python scalar reached the traced boundary and"
+                            " will recompile per concrete type",
+                            layer="jaxpr",
+                        )
+                    )
+    for sub in _iter_jaxprs(jaxpr):
+        for eqn in sub.eqns:
+            prim = eqn.primitive.name
+            if any(marker in prim for marker in _CALLBACK_MARKERS):
+                findings.append(
+                    Finding(
+                        "jaxpr-callback",
+                        path,
+                        0,
+                        f"{name}: host callback primitive {prim!r} in the"
+                        " traced path (device->host sync every execution)",
+                        layer="jaxpr",
+                    )
+                )
+            for v in list(eqn.invars) + list(eqn.outvars):
+                bad = _aval_issues(getattr(v, "aval", None))
+                if bad is not None:
+                    findings.append(
+                        Finding(
+                            "jaxpr-f64",
+                            path,
+                            0,
+                            f"{name}: {bad} aval in primitive {prim!r};"
+                            " the device tier is f32-only",
+                            layer="jaxpr",
+                        )
+                    )
+                    break
+    # One finding per (rule, entry) is enough signal; dedup repeats.
+    seen = set()
+    unique = []
+    for f in findings:
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            unique.append(f)
+    return unique
+
+
+def default_entry_points() -> List[Tuple[str, Callable, Sequence]]:
+    """The audited surface: every engine a facade can dispatch to.
+
+    Shapes are the smallest eligible configuration (128 streams, 256
+    bins = 2 tiles, 4 quantiles) -- eligibility gates, not performance,
+    decide what traces.
+    """
+    import functools
+
+    import jax.numpy as jnp
+
+    from sketches_tpu import batched, kernels
+
+    spec = batched.SketchSpec(n_bins=256)
+    state = batched.init(spec, 128)
+    values = jnp.zeros((128, 128), jnp.float32)
+    weights = jnp.ones((128, 128), jnp.float32)
+    qs = jnp.asarray([0.5, 0.9, 0.99, 0.999], jnp.float32)
+    lo = jnp.asarray(0, jnp.int32)
+
+    return [
+        ("batched.add", functools.partial(batched.add, spec), (state, values)),
+        (
+            "batched.quantile",
+            functools.partial(batched.quantile, spec),
+            (state, qs),
+        ),
+        (
+            "batched.merge",
+            functools.partial(batched.merge, spec),
+            (state, batched.init(spec, 128)),
+        ),
+        (
+            "kernels.ingest_histogram",
+            functools.partial(kernels.ingest_histogram, spec),
+            (values, weights, state.key_offset),
+        ),
+        (
+            "kernels.fused_quantile",
+            functools.partial(kernels.fused_quantile, spec),
+            (state, qs),
+        ),
+        (
+            "kernels.fused_quantile_windowed",
+            functools.partial(
+                kernels.fused_quantile_windowed, spec, n_wblocks=2, w_tiles=1
+            ),
+            (state, qs, lo),
+        ),
+        (
+            "kernels.fused_quantile_tiles",
+            functools.partial(kernels.fused_quantile_tiles, spec, k_tiles=2),
+            (state, qs),
+        ),
+        (
+            "kernels.fused_quantile_tiles_overlap",
+            functools.partial(
+                kernels.fused_quantile_tiles_overlap, spec, k_tiles=2
+            ),
+            (state, qs),
+        ),
+        (
+            "kernels.quantile_windowed_xla",
+            functools.partial(
+                kernels.quantile_windowed_xla, spec, n_tiles_window=2
+            ),
+            (state, qs, lo),
+        ),
+    ]
+
+
+def vmem_report() -> Dict:
+    """The overlap engine's worst-case VMEM footprint, from first
+    principles and the constants in ``kernels.py``.
+
+    Worst case by construction: the widest stream block
+    (``kernels._stream_block``'s largest candidate), the deepest ring
+    (``_overlap_depth`` caps at 8), and the most quantiles the tile
+    family admits (``tile_query_eligible`` caps Q at 8).
+    """
+    from sketches_tpu import kernels
+
+    bn = max((1024, 512, 256, 128))  # _stream_block's candidate set
+    # Derive instead of trusting the literal above if the source evolved:
+    try:
+        bn = max(bn, kernels._stream_block(1 << 20))
+    except Exception:  # noqa: BLE001 - constants-only fallback
+        pass
+    q_max = 8  # tile_query_eligible: "q_total <= 8" keeps the slab bounded
+    depth_max = kernels._overlap_depth(2 * q_max * 8, 8)  # cap is 8
+    lane = kernels.LO
+    f32 = 4
+
+    ring = depth_max * bn * lane * f32
+    slab = q_max * bn * lane * f32
+    packed = bn * ((4 * q_max + 5 + 7) // 8 * 8) * f32
+    out = bn * q_max * f32
+    total = ring + slab + packed + out
+    return {
+        "budget_bytes": VMEM_BUDGET_BYTES,
+        "stream_block": bn,
+        "ring_depth": depth_max,
+        "q_max": q_max,
+        "ring_bytes": ring,
+        "slab_bytes": slab,
+        "packed_bytes": packed,
+        "out_bytes": out,
+        "total_bytes": total,
+        "ok": total <= VMEM_BUDGET_BYTES,
+    }
+
+
+def audit(
+    entries: Optional[List[Tuple[str, Callable, Sequence]]] = None,
+) -> Tuple[List[Finding], Dict]:
+    """Run the full layer-2 audit -> (findings, machine-readable report).
+
+    ``entries`` defaults to :func:`default_entry_points`; tests pass
+    synthetic callables to prove each check fires.
+    """
+    if entries is None:
+        entries = default_entry_points()
+    findings: List[Finding] = []
+    report: Dict = {"entries": {}, "vmem": None}
+    for name, fn, args in entries:
+        entry_findings = audit_callable(name, fn, args)
+        findings.extend(entry_findings)
+        report["entries"][name] = {
+            "findings": [f.to_dict() for f in entry_findings],
+            "ok": not entry_findings,
+        }
+    vmem = vmem_report()
+    report["vmem"] = vmem
+    if not vmem["ok"]:
+        findings.append(
+            Finding(
+                "vmem-budget",
+                "<vmem:overlap-ring>",
+                0,
+                f"overlap engine worst case needs {vmem['total_bytes']}"
+                f" bytes of VMEM against a {vmem['budget_bytes']}-byte"
+                " budget; shrink the ring depth, stream block, or Q cap",
+                layer="jaxpr",
+            )
+        )
+    return findings, report

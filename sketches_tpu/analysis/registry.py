@@ -1,0 +1,134 @@
+"""The kill-switch inventory: every ``SKETCHES_TPU_*`` environment variable.
+
+PR 1 and PR 2 grew three process-level operational levers (native-engine
+kill switch, overlap-engine kill switch, fault arming) as ad-hoc
+``os.environ`` reads scattered across modules.  This registry is the ONE
+place such a variable may be declared and read: each entry carries the
+name, the default, the owning module, and a one-line doc (the README
+kill-switch table is generated from -- and lint-checked against -- these
+entries; see ``analysis/rules/env_registry.py``).
+
+Adding a lever means adding an :class:`EnvVar` here and reading it via
+:func:`get`/:func:`enabled`; a raw ``os.environ`` read of a
+``SKETCHES_TPU_*`` name anywhere else in the package is a lint violation
+(rule ``env-read``), as is a registry entry missing from the README
+table (rule ``registry-doc``).
+
+This module is stdlib-only and imports nothing from the rest of the
+package (it sits below ``faults``/``native``/``kernels``, which read it
+at import time), so any module may import it without cycles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "EnvVar",
+    "NATIVE",
+    "OVERLAP",
+    "FAULTS",
+    "REGISTRY",
+    "declared",
+    "get",
+    "enabled",
+    "lookup",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    """One declared environment variable.
+
+    ``default`` is the exact string :func:`get` returns when the process
+    environment does not set the variable (``None`` means "unset", for
+    variables like the fault spec whose mere presence arms behavior).
+    ``owner`` is the module whose behavior the variable controls;
+    ``doc`` is the one-line description the README table must carry.
+    """
+
+    name: str
+    default: Optional[str]
+    owner: str
+    doc: str
+
+
+#: The native-engine kill switch (``sketches_tpu.native``).
+NATIVE = EnvVar(
+    name="SKETCHES_TPU_NATIVE",
+    default="1",
+    owner="sketches_tpu.native",
+    doc=(
+        "Set to 0 to force the native C++ host engine unavailable"
+        " (pure-Python host tier); the degraded-mode CI lever."
+    ),
+)
+
+#: The overlap-query-engine kill switch (``sketches_tpu.kernels``).
+OVERLAP = EnvVar(
+    name="SKETCHES_TPU_OVERLAP",
+    default="1",
+    owner="sketches_tpu.kernels",
+    doc=(
+        "Set to 0 to disconnect the overlap query engine; facades"
+        " answer through the windowed/tiles ladder instead."
+    ),
+)
+
+#: Process-start fault arming (``sketches_tpu.faults``).
+FAULTS = EnvVar(
+    name="SKETCHES_TPU_FAULTS",
+    default=None,
+    owner="sketches_tpu.faults",
+    doc=(
+        "Semicolon-separated fault-site plans armed at process"
+        " start (e.g. native.load;wire.blob:fraction=0.01,seed=7);"
+        " unset/empty means no injection."
+    ),
+)
+
+#: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
+#: docs in sync with the README "Kill switches" table -- the ``registry-doc``
+#: lint rule cross-checks both directions.
+REGISTRY: Dict[str, EnvVar] = {v.name: v for v in (NATIVE, OVERLAP, FAULTS)}
+
+
+def declared() -> Tuple[EnvVar, ...]:
+    """Every registered variable, in declaration order."""
+    return tuple(REGISTRY.values())
+
+
+def lookup(name: str) -> EnvVar:
+    """The :class:`EnvVar` declared under ``name`` (KeyError if absent)."""
+    return REGISTRY[name]
+
+
+def _resolve(var) -> EnvVar:
+    if isinstance(var, EnvVar):
+        return REGISTRY[var.name]  # refuse undeclared ad-hoc instances too
+    return REGISTRY[var]
+
+
+def get(var) -> Optional[str]:
+    """Read a registered variable (:class:`EnvVar` or name) from the
+    process environment.
+
+    Returns the declared default when the environment does not set the
+    variable.  Raises ``KeyError`` for an undeclared variable -- reading
+    an unregistered kill switch is exactly the bug this registry exists
+    to make impossible.
+    """
+    v = _resolve(var)
+    return os.environ.get(v.name, v.default)
+
+
+def enabled(var) -> bool:
+    """Flag-style read: True unless the variable is set to ``"0"``.
+
+    The shared convention of the ``SKETCHES_TPU_NATIVE`` /
+    ``SKETCHES_TPU_OVERLAP`` kill switches: any value other than the
+    literal string ``0`` (including unset) leaves the feature on.
+    """
+    return get(var) != "0"

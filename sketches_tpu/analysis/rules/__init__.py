@@ -1,0 +1,53 @@
+"""sketchlint rules: each module encodes one repo invariant.
+
+Importing this package registers every rule with the engine
+(``lint.rule`` decorator); ``lint.all_rules()`` triggers the import.
+
+Rule inventory (ids double as the inline-ignore tags):
+
+==================  ======================================================
+id                  invariant
+==================  ======================================================
+taxonomy-raise      no bare ``ValueError``/``RuntimeError`` raises outside
+                    ``resilience.py`` -- everything derives from
+                    ``SketchError``
+env-read            ``os.environ``/``os.getenv`` reads only inside
+                    ``analysis/registry.py``
+env-literal         every ``SKETCHES_TPU_*`` string literal outside the
+                    registry must be a *declared* variable's name
+registry-doc        registry entries and the README kill-switch table
+                    agree in both directions
+engine-ladder       every engine ``choose_query_engine`` can return is a
+                    rung of ``resilience.QUERY_LADDER``, demotable by
+                    ``demote_query_tier``, and fault-dispatched in both
+                    facades
+jnp-f64             no ``float64`` construction on jnp paths (f32-only
+                    device tier)
+determinism         no ``time.time``-family wall-clock reads or unseeded
+                    ``np.random`` in library code
+failure-docstring   every public ``__all__`` symbol documents its failure
+                    modes
+host-callback       no ``pure_callback``/``io_callback``/``host_callback``
+                    in library code (hot paths must not sync to host)
+==================  ======================================================
+"""
+
+from sketches_tpu.analysis.rules import (  # noqa: F401  (import = register)
+    callbacks,
+    determinism,
+    docstrings,
+    dtypes,
+    engines,
+    env_registry,
+    raises,
+)
+
+__all__ = [
+    "callbacks",
+    "determinism",
+    "docstrings",
+    "dtypes",
+    "engines",
+    "env_registry",
+    "raises",
+]

@@ -1,0 +1,51 @@
+"""Rule ``host-callback``: no host callbacks in library code.
+
+``jax.pure_callback`` / ``jax.experimental.io_callback`` /
+``host_callback`` round-trip through the host on every execution --
+inside a query or ingest path that is a silent device->host sync that
+caps throughput at PCIe/gRPC latency and breaks the overlap engine's
+whole premise.  The AST layer flags imports and attribute uses; the
+jaxpr audit (layer 2) catches callbacks that arrive indirectly through
+a library call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from sketches_tpu.analysis.lint import Finding, LintContext, rule
+
+_FORBIDDEN = ("pure_callback", "io_callback", "host_callback", "call_tf")
+
+
+@rule("host-callback")
+def check(ctx: LintContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for sf in ctx.iter_files():
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            name = None
+            if isinstance(node, ast.Attribute) and node.attr in _FORBIDDEN:
+                name = node.attr
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name in _FORBIDDEN:
+                        name = a.name
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[-1] in _FORBIDDEN:
+                        name = a.name
+            if name is not None:
+                out.append(
+                    Finding(
+                        "host-callback",
+                        sf.path,
+                        node.lineno,
+                        f"host callback {name!r} in library code: every"
+                        " execution round-trips through the host, which"
+                        " serializes the device pipeline",
+                    )
+                )
+    return out

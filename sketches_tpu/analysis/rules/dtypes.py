@@ -1,0 +1,92 @@
+"""Rule ``jnp-f64``: the device tier is f32-only; no float64 construction
+on jnp paths.
+
+TPU has no native f64 (ops are emulated, slowly), and the kernels'
+bf16-split tricks assume f32 ceilings, so a ``float64`` that sneaks
+into a jnp expression either silently demotes (x64 off -- masking the
+author's intent) or silently de-optimizes (x64 on).  Host-side numpy
+f64 is fine and idiomatic (the host tier is *deliberately* f64); the
+rule therefore flags only f64 **construction** on jnp expressions:
+
+* a direct ``jnp.float64`` / ``"float64"`` argument to a ``jnp.*`` call
+  (``jnp.asarray(x, jnp.float64)``),
+* a ``dtype=`` keyword resolving to f64 on any call in a jnp-importing
+  module,
+* ``.astype(jnp.float64)`` / ``.astype("float64")``.
+
+Reads and comparisons (``v.dtype == jnp.float64`` -- the mapping layer's
+f64-layout support) are allowed: inspecting f64 is not creating it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from sketches_tpu.analysis.lint import Finding, LintContext, rule
+
+
+def _imports_jnp(tree: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name == "jax.numpy" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" and any(
+                a.name == "numpy" for a in node.names
+            ):
+                return True
+            if node.module == "jax.numpy":
+                return True
+    return False
+
+
+def _is_f64(node: ast.AST) -> bool:
+    """``jnp.float64`` or the ``"float64"`` string.  ``np.float64`` is
+    deliberately NOT matched: host-side numpy f64 is the host tier's
+    idiom, and the device tier never consumes a numpy dtype object
+    without an explicit jnp cast the rule would catch instead."""
+    if isinstance(node, ast.Attribute) and node.attr == "float64":
+        return isinstance(node.value, ast.Name) and node.value.id == "jnp"
+    return isinstance(node, ast.Constant) and node.value == "float64"
+
+
+def _call_root(node: ast.Call) -> str:
+    fn = node.func
+    while isinstance(fn, ast.Attribute):
+        fn = fn.value
+    return fn.id if isinstance(fn, ast.Name) else ""
+
+
+@rule("jnp-f64")
+def check(ctx: LintContext) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for sf in ctx.iter_files():
+        if sf.tree is None or not _imports_jnp(sf.tree):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            is_astype = isinstance(fn, ast.Attribute) and fn.attr == "astype"
+            is_jnp_call = _call_root(node) == "jnp"
+            flagged = False
+            if is_astype or is_jnp_call:
+                flagged = any(_is_f64(a) for a in node.args)
+            if not flagged:
+                flagged = any(
+                    kw.arg == "dtype" and _is_f64(kw.value)
+                    for kw in node.keywords
+                )
+            if flagged:
+                out.append(
+                    Finding(
+                        "jnp-f64",
+                        sf.path,
+                        node.lineno,
+                        "float64 construction on a jnp path; the device"
+                        " tier is f32-only (f64 silently demotes with x64"
+                        " off and silently de-optimizes on TPU with it on)",
+                    )
+                )
+    return out

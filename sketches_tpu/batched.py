@@ -92,6 +92,11 @@ class SketchSpec:
     TPU-specific knob the reference cannot have: ``key_offset``, the low edge
     of the static key window.  Two batches are mergeable iff their specs are
     equal (the reference's same-gamma check, made total).
+
+    Failure modes: invalid configuration (``relative_accuracy`` outside
+    (0, 1), ``n_bins < 2``, an unknown mapping name) raises ``SpecError``
+    at construction; merging across unequal specs raises
+    ``UnequalSketchParametersError``.
     """
 
     relative_accuracy: float = DEFAULT_REL_ACC
@@ -984,6 +989,15 @@ class BatchedDDSketch:
     (``add`` / ``get_quantile_value`` / ``merge`` -- SURVEY.md section 2 row
     2), vectorized over ``n_streams`` sketches.  Ingest donates the state
     pytree so XLA mutates bins in place.
+
+    Failure modes (docs/DESIGN.md section 8): a Pallas query
+    lowering/compile failure degrades down the
+    ``overlap -> tiles -> windowed -> wxla -> xla`` ladder (recorded in
+    ``resilience.health()``; only an ``xla``-floor failure re-raises), a
+    Pallas ingest failure demotes to the XLA scatter path and replays
+    the batch; empty streams and out-of-range quantiles answer NaN;
+    invalid construction raises ``SpecError`` and unequal-spec merges
+    raise ``UnequalSketchParametersError``.
     """
 
     def __init__(

@@ -262,6 +262,15 @@ class JaxDDSketch(BaseDDSketch):
     returns one of these when asked for the jax backend, and Python then
     skips ``DDSketch.__init__`` because the returned object is not a
     ``DDSketch`` instance.
+
+    Failure modes: mirrors the host tier -- non-positive weights raise
+    ``SketchValueError``, unequal-parameter merges raise
+    ``UnequalSketchParametersError``, empty-sketch quantiles return
+    ``None`` -- plus the device tier's degradations: a native-engine
+    build/load failure silently falls back to per-chunk device flushes
+    (recorded in ``resilience.health()``), and mass beyond the static
+    window collapses into the edge bins (surfaced via the collapse
+    counters, never silently lost).
     """
 
     # One jit compilation serves every flush, so the chunk is a fixed
@@ -689,6 +698,11 @@ class DDSketch(BaseDDSketch):
     ``backend='jax'`` to get the same API running on the device tier
     (:class:`JaxDDSketch`); the default pure-Python backend doubles as the
     oracle the device path is parity-tested against.
+
+    Failure modes: invalid configuration raises ``SpecError``;
+    non-positive weights raise ``SketchValueError``; quantiles of an
+    empty sketch return ``None``; merging sketches with different
+    mapping parameters raises ``UnequalSketchParametersError``.
     """
 
     def __new__(
@@ -741,7 +755,7 @@ def _reject_jax_only_kwargs(**kwargs) -> None:
     Compose ``BaseDDSketch`` directly for a non-default pure-Python sketch."""
     passed = [k for k, v in kwargs.items() if v is not None]
     if passed:
-        raise ValueError(
+        raise SpecError(
             f"{', '.join(passed)} only apply to backend='jax'; for a custom"
             " pure-Python sketch compose BaseDDSketch(mapping=..., store=...)"
         )

@@ -39,11 +39,11 @@ from __future__ import annotations
 
 import binascii
 import contextlib
-import os
 import threading
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
-from sketches_tpu.resilience import InjectedFault, bump
+from sketches_tpu.analysis import registry
+from sketches_tpu.resilience import InjectedFault, SpecError, bump
 
 __all__ = [
     "FAULTS_ENV",
@@ -63,7 +63,9 @@ __all__ = [
     "corrupt_blobs",
 ]
 
-FAULTS_ENV = "SKETCHES_TPU_FAULTS"
+#: Declared in ``analysis/registry.py`` (the kill-switch inventory);
+#: this alias keeps the historical import path working.
+FAULTS_ENV = registry.FAULTS.name
 
 NATIVE_LOAD = "native.load"
 PALLAS_LOWERING = "pallas.lowering"
@@ -117,7 +119,7 @@ class _Plan:
         exc: Optional[BaseException] = None,
     ):
         if mode not in ("raise", "corrupt", "truncate"):
-            raise ValueError(f"Unknown fault mode {mode!r}")
+            raise SpecError(f"Unknown fault mode {mode!r}")
         self.site = site
         self.times = times
         self.fraction = fraction
@@ -137,7 +139,7 @@ def arm(site: str, **kwargs) -> None:
     """Arm ``site`` with a :class:`_Plan` (see its docstring for knobs)."""
     global _ACTIVE
     if site not in SITES:
-        raise ValueError(f"Unknown fault site {site!r}; expected one of {SITES}")
+        raise SpecError(f"Unknown fault site {site!r}; expected one of {SITES}")
     with _lock:
         _plans[site] = _Plan(site, **kwargs)
         _ACTIVE = True
@@ -302,6 +304,6 @@ def _parse_env(value: str) -> None:
         arm(site.strip(), **kwargs)
 
 
-_env = os.environ.get(FAULTS_ENV)
+_env = registry.get(registry.FAULTS)
 if _env:  # pragma: no cover - exercised via subprocess in CI degraded jobs
     _parse_env(_env)

@@ -39,7 +39,6 @@ falls back to the XLA path otherwise.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -47,8 +46,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from sketches_tpu.analysis import registry
 from sketches_tpu.batched import SketchSpec, SketchState
 from sketches_tpu.mapping import zero_threshold
+from sketches_tpu.resilience import SketchValueError, SpecError
 
 __all__ = [
     "supports",
@@ -104,10 +105,10 @@ def select_engine(spec: SketchSpec, n_streams: int, engine: str):
     this so the two tiers can never diverge on the policy.
     """
     if engine not in ("auto", "xla", "pallas"):
-        raise ValueError(f"Unknown engine {engine!r}")
+        raise SpecError(f"Unknown engine {engine!r}")
     supported = supports(spec, n_streams)
     if engine == "pallas" and not supported:
-        raise ValueError(
+        raise SpecError(
             "engine='pallas' requires f32 state, 128-aligned n_bins, and a"
             " 128-aligned stream count (per-shard, when sharded over a"
             f" mesh); got {spec} with n_streams={n_streams}"
@@ -903,7 +904,7 @@ def fused_quantile_windowed(
     if n % bn != 0:
         # An oversized stream block would silently read past the arrays
         # (garbage, not an error, on both TPU and interpret backends).
-        raise ValueError(
+        raise SketchValueError(
             f"n_streams={n} must be a multiple of the stream block"
             f" ({bn}); pad the batch or pass block_streams"
         )
@@ -917,12 +918,12 @@ def fused_quantile_windowed(
     # guards bound the exposure to a window that at worst re-reads the last
     # in-range block.
     if w_tiles not in (1, 2, 4) or spec.n_bins % (w_tiles * LO) != 0:
-        raise ValueError(
+        raise SpecError(
             f"w_tiles={w_tiles} must divide the {spec.n_bins}-bin array"
             " into whole column blocks (and be one of 1/2/4)"
         )
     if not 1 <= n_wblocks <= spec.n_bins // (w_tiles * LO):
-        raise ValueError(
+        raise SpecError(
             f"n_wblocks={n_wblocks} window ({n_wblocks * w_tiles * LO} bins)"
             f" exceeds the {spec.n_bins}-bin array"
         )
@@ -1049,13 +1050,20 @@ def tile_query_eligible(spec: SketchSpec, q_total: int, window_plan) -> bool:
 
 #: Environment kill switch for the overlap engine: set to "0" to make both
 #: facades fall back to the r5 windowed/tiles ladder without a code change
-#: (the measured-dead escape hatch -- DESIGN.md 3c-r6).
-OVERLAP_ENV = "SKETCHES_TPU_OVERLAP"
+#: (the measured-dead escape hatch -- DESIGN.md 3c-r6).  Declared in
+#: ``analysis/registry.py`` (the kill-switch inventory); this alias keeps
+#: the historical import path working.
+OVERLAP_ENV = registry.OVERLAP.name
 
 
 def overlap_enabled() -> bool:
-    """Whether the facades may route eligible queries to the overlap engine."""
-    return os.environ.get(OVERLAP_ENV, "1") != "0"
+    """Whether the facades may route eligible queries to the overlap engine.
+
+    Reads the registered ``SKETCHES_TPU_OVERLAP`` kill switch; with it
+    set to ``0`` every eligible pick degrades to the tiles/windowed
+    ladder (never an error -- the engines are answer-identical).
+    """
+    return registry.enabled(registry.OVERLAP)
 
 
 def choose_query_engine(window_plan, tile_plan, overlap_ok: bool = False) -> str:
@@ -1485,18 +1493,18 @@ def fused_quantile_tiles(
             " query via quantile_windowed_xla (exact integer compare)"
         )
     if spec.n_bins % LO != 0:
-        raise ValueError("tile-list query requires 128-aligned n_bins")
+        raise SpecError("tile-list query requires 128-aligned n_bins")
     qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
     q_total = qs.shape[0]
     if q_total == 0:
         return jnp.zeros((n, 0), jnp.float32)
     bn = block_streams or _stream_block(n)
     if n % bn != 0:
-        raise ValueError(
+        raise SketchValueError(
             f"n_streams={n} must be a multiple of the stream block ({bn})"
         )
     if not 1 <= k_tiles <= t:
-        raise ValueError(f"k_tiles={k_tiles} outside [1, {t}]")
+        raise SpecError(f"k_tiles={k_tiles} outside [1, {t}]")
 
     lists_pos, lists_neg, packed = _tile_query_operands(
         spec, state, qs, bn, k_tiles
@@ -1739,20 +1747,20 @@ def fused_quantile_tiles_overlap(
             " specs query via quantile_windowed_xla (exact integer compare)"
         )
     if spec.n_bins % LO != 0:
-        raise ValueError("tile-list query requires 128-aligned n_bins")
+        raise SpecError("tile-list query requires 128-aligned n_bins")
     qs = jnp.atleast_1d(jnp.asarray(qs, jnp.float32))
     q_total = qs.shape[0]
     if q_total == 0:
         return jnp.zeros((n, 0), jnp.float32)
     bn = block_streams or _stream_block(n)
     if n % bn != 0:
-        raise ValueError(
+        raise SketchValueError(
             f"n_streams={n} must be a multiple of the stream block ({bn})"
         )
     if not 1 <= k_tiles <= t:
-        raise ValueError(f"k_tiles={k_tiles} outside [1, {t}]")
+        raise SpecError(f"k_tiles={k_tiles} outside [1, {t}]")
     if lookahead < 1:
-        raise ValueError(f"lookahead={lookahead} must be >= 1")
+        raise SpecError(f"lookahead={lookahead} must be >= 1")
     n_steps = (2 if with_neg else 1) * k_tiles
     depth = _overlap_depth(n_steps, lookahead)
 
@@ -1824,10 +1832,10 @@ def quantile_windowed_xla(
     if q_total == 0:
         return jnp.zeros((n, 0), spec.dtype)
     if spec.n_bins % LO != 0:
-        raise ValueError("windowed XLA query requires 128-aligned n_bins")
+        raise SpecError("windowed XLA query requires 128-aligned n_bins")
     tiles_total = spec.n_bins // LO
     if not 1 <= n_tiles_window <= tiles_total:
-        raise ValueError(
+        raise SpecError(
             f"n_tiles_window={n_tiles_window} outside [1, {tiles_total}]"
         )
     width = n_tiles_window * LO

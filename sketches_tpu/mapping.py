@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sketches_tpu.resilience import SpecError
+
 def zero_threshold(dtype) -> float:
     """|v| below this lands in the zero bucket: the smallest positive normal
     of ``dtype``.
@@ -70,11 +72,16 @@ class KeyMapping:
     gamma = (1 + alpha) / (1 - alpha); bucket k covers (gamma^(k-1), gamma^k]
     (modulo the subclass's log approximation), and ``value(k)`` returns the
     point whose relative distance to both endpoints is exactly alpha.
+
+    Failure modes: ``relative_accuracy`` outside (0, 1) raises
+    ``SpecError`` (a ``ValueError`` subclass); ``key()`` is defined for
+    strictly positive values only -- the sketches route zeros and
+    negatives to the zero bucket / negative store *before* keying.
     """
 
     def __init__(self, relative_accuracy: float, offset: float = 0.0):
         if relative_accuracy <= 0 or relative_accuracy >= 1:
-            raise ValueError("Relative accuracy must be between 0 and 1.")
+            raise SpecError("Relative accuracy must be between 0 and 1.")
         self.relative_accuracy = float(relative_accuracy)
         self._offset = float(offset)
 
@@ -161,7 +168,13 @@ class KeyMapping:
 
 
 class LogarithmicMapping(KeyMapping):
-    """Exact ``ln(v) / ln(gamma)`` mapping -- memory-optimal, one log per key."""
+    """Exact ``ln(v) / ln(gamma)`` mapping -- memory-optimal, one log per key.
+
+    Failure modes: inherits ``KeyMapping``'s ``SpecError`` on an invalid
+    ``relative_accuracy``; ``key()`` of a non-positive value is a math
+    domain error (callers pre-route those to the zero bucket / negative
+    store).
+    """
 
     def __init__(self, relative_accuracy: float, offset: float = 0.0):
         super().__init__(relative_accuracy, offset=offset)
@@ -259,6 +272,13 @@ class LinearlyInterpolatedMapping(KeyMapping):
     alpha near octave bottoms.  Cost: 1/ln2 ~= 1.44x the buckets of the exact
     log, in exchange for replacing the transcendental log with exponent
     bit-twiddling.
+
+    Failure modes: inherits ``KeyMapping``'s ``SpecError`` on an invalid
+    ``relative_accuracy``.  Because this multiplier convention is
+    implementation-defined across the DDSketch family, foreign wire
+    bytes carrying a LINEAR mapping are *refused* by default on decode
+    (``pb.proto.KeyMappingProto.from_proto``) -- a mismatch would
+    silently misdecode every bin.
     """
 
     def _log2_approx(self, value: float) -> float:
@@ -378,6 +398,11 @@ class CubicallyInterpolatedMapping(KeyMapping):
     i.e. 0.7/ln2 ~= 1.0100x the bucket count of the exact log (the ~1%
     overhead), at far lower per-value cost.
 
+    Failure modes: inherits ``KeyMapping``'s ``SpecError`` on an invalid
+    ``relative_accuracy``; ``key()`` of a non-positive value is
+    undefined (callers pre-route those to the zero bucket / negative
+    store).
+
     The inverse solves the monotone cubic with a fixed 5-step Newton iteration
     (see module docstring) rather than Cardano's formula.
     """
@@ -464,7 +489,7 @@ def mapping_from_name(name: str, relative_accuracy: float, offset: float = 0.0) 
     try:
         cls = _MAPPING_REGISTRY[name]
     except KeyError:
-        raise ValueError(
+        raise SpecError(
             f"Unknown mapping {name!r}; expected one of {sorted(_MAPPING_REGISTRY)}"
         ) from None
     return cls(relative_accuracy, offset=offset)

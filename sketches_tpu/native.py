@@ -24,6 +24,7 @@ import typing
 import numpy as np
 
 from sketches_tpu import faults, resilience
+from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import EngineUnavailable, SpecError
 
 __all__ = ["available", "reset", "NativeDDSketch", "NATIVE_ENV"]
@@ -31,7 +32,9 @@ __all__ = ["available", "reset", "NativeDDSketch", "NATIVE_ENV"]
 #: Environment kill switch: ``SKETCHES_TPU_NATIVE=0`` forces the native
 #: engine unavailable (pure-Python host tier), for degraded-mode CI and
 #: for operating around a broken toolchain without a code change.
-NATIVE_ENV = "SKETCHES_TPU_NATIVE"
+#: Declared in ``analysis/registry.py`` (the kill-switch inventory);
+#: this alias keeps the historical import path working.
+NATIVE_ENV = registry.NATIVE.name
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libddsketch_host.so")
@@ -79,7 +82,7 @@ def _load() -> typing.Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if os.environ.get(NATIVE_ENV, "1") == "0":
+        if not registry.enabled(registry.NATIVE):
             _build_error = f"disabled via {NATIVE_ENV}=0"
             resilience.record_downgrade(
                 "native", "native", "python", _build_error
@@ -258,7 +261,9 @@ class NativeDDSketch:
         if weights is not None:
             weights = np.ascontiguousarray(weights, dtype=np.float64).ravel()
             if weights.shape != values.shape:
-                raise ValueError("weights shape must match values")
+                raise resilience.SketchValueError(
+                    "weights shape must match values"
+                )
             wptr = _dptr(weights)
         self._lib.sketch_add_batch(self._handle, _dptr(values), wptr, values.size)
         return self

@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import List
 
 from sketches_tpu.ddsketch import BaseDDSketch, DDSketch
+from sketches_tpu.resilience import SketchValueError, WireDecodeError
 from sketches_tpu.mapping import (
     CubicallyInterpolatedMapping,
     KeyMapping,
@@ -58,7 +59,7 @@ class KeyMappingProto:
         try:
             interpolation = _MAPPING_TO_INTERPOLATION[type(mapping)]
         except KeyError:
-            raise ValueError(
+            raise SketchValueError(
                 f"No proto interpolation for mapping {type(mapping).__name__}"
             ) from None
         return pb.IndexMapping(
@@ -94,14 +95,14 @@ class KeyMappingProto:
         try:
             mapping_cls = _INTERPOLATION_TO_MAPPING[proto.interpolation]
         except KeyError:
-            raise ValueError(
+            raise WireDecodeError(
                 f"Unsupported interpolation {proto.interpolation}"
             ) from None
         if (
             mapping_cls is LinearlyInterpolatedMapping
             and not assume_native_linear
         ):
-            raise ValueError(
+            raise WireDecodeError(
                 "Refusing to decode a LINEAR IndexMapping from foreign"
                 " bytes: the linear-interpolation key-multiplier convention"
                 " is implementation-defined and a mismatch silently"

@@ -38,7 +38,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -105,7 +105,8 @@ class WireDecodeError(SketchValueError):
 
 
 class BlobTooLarge(WireDecodeError):
-    """A wire blob exceeds the caller's ``max_blob_bytes`` admission cap."""
+    """Raised (or quarantined as ``over_limit``) when a wire blob exceeds
+    the caller's ``max_blob_bytes`` admission cap."""
 
 
 class CheckpointCorrupt(SketchError):
@@ -122,7 +123,8 @@ class EngineUnavailable(SketchError, RuntimeError):
 
 
 class ShardLossError(SketchError):
-    """Unrecoverable shard loss: no live shard remains to fold."""
+    """Raised on unrecoverable shard loss: no live shard remains to fold
+    (partial loss degrades instead -- see ``ShardLossReport``)."""
 
 
 class InjectedFault(SketchError):
@@ -157,6 +159,8 @@ def record_downgrade(
 ) -> DowngradeEvent:
     """Record one degradation step into the process-wide health ledger."""
     ev = DowngradeEvent(
+        # Ledger timestamps are operator-facing observability, not replay
+        # state: nothing branches on them.  sketchlint: ignore[determinism]
         component, from_tier, to_tier, str(reason)[:500], time.time()
     )
     with _lock:

@@ -32,6 +32,11 @@ class Store(abc.ABC):
     """Bin-count storage contract: integer keys -> float weights.
 
     Reference seam: ``ddsketch/store.py . Store``.
+
+    Failure modes: ``merge`` raises ``TypeError`` for an incompatible
+    store type; ``key_at_rank`` on an empty store is undefined --
+    callers guard on ``is_empty`` (the sketches return ``None``/NaN for
+    empty-sketch quantiles instead of calling in).
     """
 
     count: float
@@ -68,6 +73,12 @@ class DenseStore(Store):
 
     Reference seam: ``ddsketch/store.py . DenseStore``.  Growth happens in
     ``CHUNK_SIZE`` steps; ``key_at_rank`` is a linear cumulative walk.
+
+    Failure modes: ``merge`` of a non-dense store raises ``TypeError``;
+    growth is unbounded by design (the collapsing subclasses bound it by
+    folding overflow mass into the edge bins instead of failing), and
+    ``key_at_rank`` on an empty store is undefined (guard on
+    ``is_empty``).
     """
 
     def __init__(self, chunk_size: int = CHUNK_SIZE):

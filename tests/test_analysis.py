@@ -1,0 +1,570 @@
+"""sketchlint acceptance suite: every rule must flag its fixture and pass
+its clean twin, the live tree must be clean, and the CLI must exit-code
+accordingly.
+
+Layer 1 fixtures are tiny synthetic package trees written to tmp_path --
+the engine scans any root, so each rule is proven to *fire* (a lint that
+never fires is indistinguishable from no lint) and to stay quiet on
+compliant code.  Layer 2 is proven the same way with synthetic
+callables.  The live-tree tests then pin the repo itself to zero
+non-baselined findings, which is exactly what the CI static-analysis
+job enforces.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import sketches_tpu
+from sketches_tpu.analysis import jaxpr_audit, registry
+from sketches_tpu.analysis.lint import (
+    apply_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.join(REPO_ROOT, "sketches_tpu")
+
+
+def make_pkg(tmp_path, files, readme=None, name="fixturepkg"):
+    """Write a synthetic package tree and return its root path."""
+    pkg = tmp_path / name
+    for rel, content in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    if readme is not None:
+        (tmp_path / "README.md").write_text(readme)
+    return str(pkg)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Layer 1: each rule flags its fixture and passes a clean twin
+# ---------------------------------------------------------------------------
+
+
+class TestTaxonomyRaise:
+    def test_flags_bare_valueerror_and_runtimeerror(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "def f():\n"
+                "    raise ValueError('nope')\n"
+                "def g():\n"
+                "    raise RuntimeError('nope')\n"
+            ),
+        })
+        found = run_lint(root, only=["taxonomy-raise"])
+        assert len(found) == 2
+        assert {f.line for f in found} == {2, 4}
+
+    def test_passes_taxonomy_and_exempt_files(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "from pkg.resilience import SpecError\n"
+                "def f():\n"
+                "    raise SpecError('structured')\n"
+                "def g():\n"
+                "    raise TypeError('caller bug, allowed')\n"
+            ),
+            # The taxonomy's home defines the dual-base classes itself.
+            "resilience.py": "def f():\n    raise ValueError('home')\n",
+        })
+        assert run_lint(root, only=["taxonomy-raise"]) == []
+
+    def test_inline_suppression(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "def f():\n"
+                "    # justified here.  sketchlint: ignore[taxonomy-raise]\n"
+                "    raise ValueError('grandfathered')\n"
+            ),
+        })
+        assert run_lint(root, only=["taxonomy-raise"]) == []
+
+
+class TestEnvRegistry:
+    def test_flags_environ_read_outside_registry(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "import os\nX = os.environ.get('HOME')\n",
+        })
+        assert rules_of(run_lint(root, only=["env-read"])) == {"env-read"}
+
+    def test_registry_module_may_read_environ(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "analysis/registry.py": "import os\nX = os.environ.get('HOME')\n",
+        })
+        assert run_lint(root, only=["env-read"]) == []
+
+    def test_flags_undeclared_and_duplicate_literals(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "analysis/registry.py": (
+                "class EnvVar:\n"
+                "    def __init__(self, name, default=None, owner='',"
+                " doc=''):\n"
+                "        self.name = name\n"
+                "X = EnvVar(name='SKETCHES_TPU_X')\n"
+            ),
+            "mod.py": (
+                "DECLARED_DUP = 'SKETCHES_TPU_X'\n"
+                "UNDECLARED = 'SKETCHES_TPU_BOGUS'\n"
+            ),
+        })
+        found = run_lint(root, only=["env-literal"])
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 2
+        assert "duplicates the registry" in msgs
+        assert "not declared" in msgs
+
+    def test_readme_cross_check_both_directions(self, tmp_path):
+        reg = (
+            "class EnvVar:\n"
+            "    def __init__(self, name, default=None, owner='', doc=''):\n"
+            "        self.name = name\n"
+            "X = EnvVar(name='SKETCHES_TPU_X')\n"
+        )
+        # Declared but undocumented -> finding.
+        root = make_pkg(tmp_path / "a", {"analysis/registry.py": reg},
+                        readme="no switches here")
+        found = run_lint(root, only=["registry-doc"])
+        assert any("missing from the README" in f.message for f in found)
+        # Documented but undeclared -> finding.
+        root = make_pkg(tmp_path / "b", {"analysis/registry.py": reg},
+                        readme="`SKETCHES_TPU_X` and `SKETCHES_TPU_GHOST`")
+        found = run_lint(root, only=["registry-doc"])
+        assert any("does not declare" in f.message for f in found)
+        # Agreement -> clean.
+        root = make_pkg(tmp_path / "c", {"analysis/registry.py": reg},
+                        readme="table: `SKETCHES_TPU_X` default 1")
+        assert run_lint(root, only=["registry-doc"]) == []
+
+
+class TestEngineLadder:
+    LADDER_OK = (
+        "QUERY_LADDER = ('tiles', 'xla')\n"
+        "def demote_query_tier(disabled, tier):\n"
+        "    if tier == 'tiles':\n"
+        "        return 'xla'\n"
+        "    return None\n"
+    )
+
+    def test_flags_engine_outside_ladder(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "kernels.py": (
+                "def choose_query_engine(a, b):\n"
+                "    return 'warp'\n"
+            ),
+            "resilience.py": self.LADDER_OK,
+        })
+        found = run_lint(root, only=["engine-ladder"])
+        assert any("not a rung" in f.message for f in found)
+
+    def test_flags_facade_without_fault_dispatch(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "kernels.py": (
+                "def choose_query_engine(a, b):\n"
+                "    return 'tiles'\n"
+            ),
+            "resilience.py": self.LADDER_OK,
+            "batched.py": "def query():\n    return 1\n",
+        })
+        found = run_lint(root, only=["engine-ladder"])
+        assert any("PALLAS_LOWERING" in f.message for f in found)
+
+    def test_consistent_tree_is_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "kernels.py": (
+                "def choose_query_engine(a, b):\n"
+                "    if a:\n"
+                "        return 'tiles'\n"
+                "    return 'xla'\n"
+            ),
+            "resilience.py": self.LADDER_OK,
+            "batched.py": (
+                "import faults\n"
+                "def query(tier):\n"
+                "    faults.inject(faults.PALLAS_LOWERING, tier=tier)\n"
+            ),
+        })
+        assert run_lint(root, only=["engine-ladder"]) == []
+
+
+class TestJnpF64:
+    def test_flags_jnp_f64_construction(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "import jax.numpy as jnp\n"
+                "def f(y):\n"
+                "    a = jnp.asarray(y, jnp.float64)\n"
+                "    b = y.astype('float64')\n"
+                "    c = jnp.zeros(4, dtype=jnp.float64)\n"
+                "    return a, b, c\n"
+            ),
+        })
+        assert len(run_lint(root, only=["jnp-f64"])) == 3
+
+    def test_host_numpy_f64_and_comparisons_allowed(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "import jax.numpy as jnp\n"
+                "import numpy as np\n"
+                "def f(y, v):\n"
+                "    host = np.asarray(y, np.float64)\n"
+                "    ctg = np.ascontiguousarray(y, dtype=np.float64)\n"
+                "    is64 = v.dtype == jnp.float64\n"
+                "    return host, ctg, is64\n"
+            ),
+        })
+        assert run_lint(root, only=["jnp-f64"]) == []
+
+
+class TestDeterminism:
+    def test_flags_wallclock_and_global_rng(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "import time\n"
+                "import numpy as np\n"
+                "def f():\n"
+                "    t = time.time()\n"
+                "    x = np.random.rand(3)\n"
+                "    return t, x\n"
+            ),
+        })
+        found = run_lint(root, only=["determinism"])
+        assert len(found) == 2
+
+    def test_sleep_and_seeded_rng_allowed(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "import time\n"
+                "import numpy as np\n"
+                "def f():\n"
+                "    time.sleep(0.01)\n"
+                "    rng = np.random.default_rng(7)\n"
+                "    return rng.normal(size=3)\n"
+            ),
+        })
+        assert run_lint(root, only=["determinism"]) == []
+
+
+class TestFailureDocstring:
+    def test_flags_missing_and_vocabulary_free_docstrings(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "__init__.py": (
+                "from fixturepkg.mod import f, g\n"
+                "__all__ = ['f', 'g']\n"
+            ),
+            "mod.py": (
+                "def f():\n"
+                "    pass\n"
+                "def g():\n"
+                "    '''Does a thing, quickly.'''\n"
+            ),
+        })
+        found = run_lint(root, only=["failure-docstring"])
+        assert len(found) == 2
+        msgs = "\n".join(f.message for f in found)
+        assert "no docstring" in msgs
+        assert "never mentions" in msgs
+
+    def test_failure_mode_docstrings_pass(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "__init__.py": (
+                "from fixturepkg.mod import f\n"
+                "__all__ = ['f', '__version__']\n"
+                "__version__ = '1.0'\n"
+            ),
+            "mod.py": (
+                "def f():\n"
+                "    '''Computes x.  Raises SpecError on bad input.'''\n"
+            ),
+        })
+        assert run_lint(root, only=["failure-docstring"]) == []
+
+
+class TestHostCallback:
+    def test_flags_callback_import_and_use(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "import jax\n"
+                "from jax import pure_callback\n"
+                "def f(x):\n"
+                "    return jax.pure_callback(abs, x, x)\n"
+            ),
+        })
+        found = run_lint(root, only=["host-callback"])
+        assert len(found) == 2
+
+
+class TestBaseline:
+    def test_baseline_suppresses_then_goes_stale(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "def f():\n    raise ValueError('x')\n",
+        })
+        found = run_lint(root, only=["taxonomy-raise"])
+        assert found
+        bl_path = str(tmp_path / "baseline.json")
+        write_baseline(bl_path, found)
+        baseline = load_baseline(bl_path)
+        assert apply_baseline(found, baseline) == []
+        # A fresh, different violation is NOT covered.
+        root2 = make_pkg(tmp_path / "v2", {
+            "mod.py": "def f():\n    raise RuntimeError('new')\n",
+        })
+        found2 = run_lint(root2, only=["taxonomy-raise"])
+        assert apply_baseline(found2, baseline) == found2
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+    def test_fingerprints_survive_line_drift(self, tmp_path):
+        src = "def f():\n    raise ValueError('x')\n"
+        root = make_pkg(tmp_path / "a", {"mod.py": src})
+        drifted = make_pkg(tmp_path / "b", {"mod.py": "\n\n\n" + src})
+        fp = lambda r: [f.fingerprint for f in run_lint(r, only=["taxonomy-raise"])]
+        assert fp(root) == fp(drifted)
+
+    def test_syntax_error_is_a_finding_not_a_crash(self, tmp_path):
+        root = make_pkg(tmp_path, {"mod.py": "def f(:\n"})
+        found = run_lint(root)
+        assert rules_of(found) == {"syntax"}
+
+
+# ---------------------------------------------------------------------------
+# Layer 2: jaxpr audit
+# ---------------------------------------------------------------------------
+
+
+class TestJaxprAudit:
+    def test_flags_host_callback_primitive(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        def bad(x):
+            return jax.pure_callback(
+                np.sin, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+            )
+
+        found = jaxpr_audit.audit_callable(
+            "fixture.bad", bad, (jnp.ones(4, jnp.float32),)
+        )
+        assert "jaxpr-callback" in {f.rule for f in found}
+
+    def test_flags_weak_typed_boundary(self):
+        found = jaxpr_audit.audit_callable(
+            "fixture.weak", lambda x: x * 2, (1.0,)
+        )
+        assert "jaxpr-weak-type" in {f.rule for f in found}
+
+    def test_clean_entry_has_no_findings(self):
+        import jax.numpy as jnp
+
+        found = jaxpr_audit.audit_callable(
+            "fixture.clean",
+            lambda x: (x * 2).sum(),
+            (jnp.ones((4, 4), jnp.float32),),
+        )
+        assert found == []
+
+    def test_trace_failure_is_a_finding(self):
+        def broken(x):
+            raise TypeError("untraceable")
+
+        found = jaxpr_audit.audit_callable("fixture.broken", broken, (1,))
+        assert [f.rule for f in found] == ["jaxpr-trace"]
+
+    def test_f64_dtype_predicate(self):
+        import numpy as np
+
+        class FakeAval:
+            dtype = np.dtype("float64")
+
+        assert jaxpr_audit._aval_issues(FakeAval()) == "float64"
+        FakeAval.dtype = np.dtype("float32")
+        assert jaxpr_audit._aval_issues(FakeAval()) is None
+
+    def test_vmem_budget_holds_with_headroom(self):
+        report = jaxpr_audit.vmem_report()
+        assert report["ok"]
+        # The worst case must leave Mosaic real headroom for its own
+        # operand double-buffering, not just squeak under the budget.
+        assert report["total_bytes"] <= report["budget_bytes"] * 0.75
+        assert report["ring_bytes"] == (
+            report["ring_depth"] * report["stream_block"] * 128 * 4
+        )
+
+
+# ---------------------------------------------------------------------------
+# The kill-switch registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_defaults_bit_identical_to_pre_registry_reads(self, monkeypatch):
+        for var in registry.declared():
+            monkeypatch.delenv(var.name, raising=False)
+        # native/overlap: unset meant enabled; faults: unset meant None.
+        assert registry.get(registry.NATIVE) == "1"
+        assert registry.get(registry.OVERLAP) == "1"
+        assert registry.get(registry.FAULTS) is None
+        assert registry.enabled(registry.NATIVE)
+        assert registry.enabled(registry.OVERLAP)
+
+    def test_environment_overrides(self, monkeypatch):
+        monkeypatch.setenv("SKETCHES_TPU_OVERLAP", "0")
+        assert not registry.enabled(registry.OVERLAP)
+        monkeypatch.setenv("SKETCHES_TPU_OVERLAP", "weird")
+        assert registry.enabled(registry.OVERLAP)  # only "0" disables
+
+    def test_undeclared_name_refused(self):
+        with pytest.raises(KeyError):
+            registry.get("SKETCHES_TPU_BOGUS")
+        with pytest.raises(KeyError):
+            registry.get(
+                registry.EnvVar("SKETCHES_TPU_BOGUS", None, "x", "y")
+            )
+
+    def test_module_aliases_point_at_registry(self):
+        from sketches_tpu import faults, kernels, native
+
+        assert native.NATIVE_ENV == registry.NATIVE.name
+        assert kernels.OVERLAP_ENV == registry.OVERLAP.name
+        assert faults.FAULTS_ENV == registry.FAULTS.name
+
+    def test_overlap_kill_switch_still_works_via_registry(self, monkeypatch):
+        from sketches_tpu import kernels
+
+        monkeypatch.setenv("SKETCHES_TPU_OVERLAP", "0")
+        assert not kernels.overlap_enabled()
+        monkeypatch.delenv("SKETCHES_TPU_OVERLAP")
+        assert kernels.overlap_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Regression tests for bugs the pass surfaced (taxonomy bypasses)
+# ---------------------------------------------------------------------------
+
+
+class TestSurfacedBugs:
+    def test_faults_arm_unknown_site_is_spec_error(self):
+        from sketches_tpu import faults
+        from sketches_tpu.resilience import SketchError, SpecError
+
+        with pytest.raises(SpecError):
+            faults.arm("no.such.site")
+        # The taxonomy promise: catchable as SketchError AND as the
+        # legacy ValueError (pre-r7 handlers).
+        with pytest.raises(SketchError):
+            faults.arm("no.such.site")
+        with pytest.raises(ValueError):
+            faults.arm("no.such.site", mode="bogus")
+
+    def test_mapping_from_name_unknown_is_spec_error(self):
+        from sketches_tpu.mapping import mapping_from_name
+        from sketches_tpu.resilience import SpecError
+
+        with pytest.raises(SpecError):
+            mapping_from_name("polynomial", 0.01)
+
+    def test_foreign_linear_refusal_is_wire_decode_error(self):
+        from sketches_tpu.mapping import LinearlyInterpolatedMapping
+        from sketches_tpu.pb.proto import KeyMappingProto
+        from sketches_tpu.resilience import SketchError, WireDecodeError
+
+        proto = KeyMappingProto.to_proto(
+            LinearlyInterpolatedMapping(0.01)
+        )
+        with pytest.raises(WireDecodeError):
+            KeyMappingProto.from_proto(proto)
+        with pytest.raises(SketchError):
+            KeyMappingProto.from_proto(proto)
+
+    def test_native_ragged_weights_is_sketch_value_error(self):
+        import numpy as np
+
+        from sketches_tpu import native
+        from sketches_tpu.resilience import SketchValueError
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        sk = native.NativeDDSketch(0.01, n_bins=256)
+        with pytest.raises(SketchValueError):
+            sk.add_batch(np.ones(8), np.ones(4))
+
+
+# ---------------------------------------------------------------------------
+# The live tree and the CLI
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTree:
+    def test_zero_non_baselined_lint_findings(self):
+        findings = run_lint(PKG_ROOT)
+        baseline = load_baseline(
+            os.path.join(PKG_ROOT, "analysis", "baseline.json")
+        )
+        active = apply_baseline(findings, baseline)
+        assert active == [], "\n".join(str(f) for f in active)
+
+    def test_zero_jaxpr_audit_findings(self):
+        findings, report = jaxpr_audit.audit()
+        assert findings == [], "\n".join(str(f) for f in findings)
+        assert report["vmem"]["ok"]
+        assert len(report["entries"]) >= 9
+        assert all(e["ok"] for e in report["entries"].values())
+
+    def test_package_version_bumped(self):
+        assert sketches_tpu.__version__ >= "0.7.0"
+
+
+class TestCli:
+    def _run(self, *args, cwd=REPO_ROOT):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, "-m", "sketches_tpu.analysis", *args],
+            capture_output=True,
+            text=True,
+            cwd=cwd,
+            env=env,
+            timeout=240,
+        )
+
+    def test_clean_tree_exits_zero(self, tmp_path):
+        report = tmp_path / "report.json"
+        proc = self._run("--no-jaxpr", "--json", str(report))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout
+        data = json.loads(report.read_text())
+        assert data["layers"]["lint"] is True
+
+    def test_injected_violation_exits_nonzero(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "def f():\n    raise ValueError('injected')\n",
+        })
+        proc = self._run("--no-jaxpr", "--root", root)
+        assert proc.returncode == 1
+        assert "taxonomy-raise" in proc.stdout
+
+    def test_update_baseline_then_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "def f():\n    raise ValueError('injected')\n",
+        })
+        bl = tmp_path / "bl.json"
+        proc = self._run(
+            "--no-jaxpr", "--root", root, "--baseline", str(bl),
+            "--update-baseline",
+        )
+        assert proc.returncode == 0
+        proc = self._run(
+            "--no-jaxpr", "--root", root, "--baseline", str(bl)
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -27,7 +27,7 @@ from sketches_tpu.batched import (
     quantile,
     to_host_sketches,
 )
-from tests.datasets import ALL_DATASETS, EPSILON, Normal
+from tests.datasets import ALL_DATASETS, Normal
 
 TEST_REL_ACC = 0.05
 TEST_N_BINS = 1024

@@ -191,7 +191,6 @@ def test_atomic_write_survives_simulated_crash(tmp_path):
 def test_pre_checksum_checkpoints_still_restore(tmp_path):
     """A checkpoint without the __checksum__ member (pre-r7 format)
     restores unvalidated -- backward compatibility."""
-    import json
     import zipfile
 
     sk = _small_sketch()

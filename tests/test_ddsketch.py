@@ -5,7 +5,6 @@ section 4): relative-error contract across ~17 distributions and sizes; merge
 as semantic equivalence (sketch(A) U sketch(B) ~ sketch(A+B)); weighted adds;
 zero/negative handling."""
 
-import math
 
 import pytest
 

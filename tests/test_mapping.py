@@ -2,7 +2,6 @@
 paths, round-trips, equality.  Mirrors reference ``tests/test_mapping.py``
 (SURVEY.md section 2 row 12, section 4)."""
 
-import math
 
 import jax.numpy as jnp
 import numpy as np

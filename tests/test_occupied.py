@@ -28,7 +28,6 @@ from sketches_tpu.batched import (
     init,
     merge,
     merge_axis,
-    quantile,
     recenter,
     to_host_sketches,
 )

@@ -140,8 +140,6 @@ def test_bulk_serde_scales_and_roundtrips():
     exactly through the wire round-trip."""
     import time
 
-    from sketches_tpu.batched import from_host_sketches, to_host_sketches
-
     n = 100_000
     spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
     rng = np.random.RandomState(0)

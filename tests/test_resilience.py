@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import sketches_tpu
 from sketches_tpu import faults, resilience
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, quantile
-from sketches_tpu.parallel import DistributedDDSketch, fold_live_partials
+from sketches_tpu.parallel import DistributedDDSketch
 from sketches_tpu.pb import wire
 from sketches_tpu.resilience import (
     BlobTooLarge,

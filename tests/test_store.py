@@ -4,7 +4,6 @@ Mirrors reference ``tests/test_store.py`` (SURVEY.md section 2 row 11):
 add/merge/extremes, bin_limit collapse (mass conservation into the edge bin),
 key_at_rank tie-breaking."""
 
-import math
 
 import pytest
 
