@@ -1240,6 +1240,8 @@ def main():
     headline = c1["ingest_fused_per_s"]
     jax_scalar = bench_jax_scalar()
     serde = bench_serde()
+    from sketches_tpu import telemetry
+
     doc = {
         "metric": "batched_ingest_throughput",
         "value": headline,
@@ -1259,6 +1261,12 @@ def main():
         "verify_pallas_vs_xla_on_device": verify,
         "host_sync_floor_s": sync_floor_s,
         "device": device,
+        # Self-sketching telemetry snapshot of this bench process (empty
+        # counters/histograms unless SKETCHES_TPU_TELEMETRY armed it --
+        # armed runs measure the armed overhead, so the default stays
+        # off); `python -m sketches_tpu.telemetry --check-bench OLD NEW`
+        # gates two of these documents against per-metric thresholds.
+        "telemetry": telemetry.snapshot(),
     }
     # Full document: stdout (for humans / logs) AND a local file -- the
     # driver's stdout tail capture truncates the big object mid-line

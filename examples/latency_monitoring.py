@@ -8,6 +8,13 @@ A second "region" maintains its own sketch batch; cross-region aggregation
 is a single elementwise merge (on a real multi-pod deployment the same
 merge rides ICI/DCN collectives via sketches_tpu.parallel).
 
+This example also demonstrates the telemetry layer *watching itself*:
+with ``sketches_tpu.telemetry`` armed, every facade dispatch above feeds
+the library's own DDSketch-backed latency histograms (the paper's
+production-monitoring use case, applied to the library), user phases are
+timed with trace spans, and the whole run exports as a Prometheus text
+exposition + a Chrome-trace JSON.
+
 Run anywhere (CPU by default; pin JAX_PLATFORMS=tpu to use an accelerator):
     python examples/latency_monitoring.py
 """
@@ -27,11 +34,25 @@ if _SELF_PROVISIONED:
 
 import numpy as np
 
-from sketches_tpu import BatchedDDSketch, DDSketch
+from sketches_tpu import BatchedDDSketch, DDSketch, telemetry
 
 N_ENDPOINTS = 1024
 BATCH = 4096  # latency samples per endpoint per flush
 QS = [0.5, 0.9, 0.99, 0.999]
+
+# User-space metrics ride the same inventory discipline as the library's:
+# declare once, then every span/counter name is checked (an undeclared
+# name raises instead of silently forking the inventory).
+telemetry.declare(
+    "example.ingest_s", "histogram", "one region's ingest cycle", owner=__name__
+)
+telemetry.declare(
+    "example.query_s", "histogram", "fleet-wide fused quantile query",
+    owner=__name__,
+)
+telemetry.declare(
+    "example.flushes", "counter", "ingest cycles completed", owner=__name__
+)
 
 
 def simulate_latencies(rng, n_endpoints, batch):
@@ -44,20 +65,25 @@ def simulate_latencies(rng, n_endpoints, batch):
 
 def main():
     rng = np.random.default_rng(42)
+    telemetry.enable()  # arm the self-sketching layer for this run
 
     # One sketch per endpoint, 1% relative accuracy, on-device.
     region_a = BatchedDDSketch(N_ENDPOINTS, relative_accuracy=0.01, n_bins=2048)
     region_b = BatchedDDSketch(N_ENDPOINTS, relative_accuracy=0.01, n_bins=2048)
 
     for _flush in range(4):  # four ingest cycles per region
-        region_a.add(simulate_latencies(rng, N_ENDPOINTS, BATCH))
-        region_b.add(simulate_latencies(rng, N_ENDPOINTS, BATCH))
+        with telemetry.span("example.ingest_s", region="a"):
+            region_a.add(simulate_latencies(rng, N_ENDPOINTS, BATCH))
+        with telemetry.span("example.ingest_s", region="b"):
+            region_b.add(simulate_latencies(rng, N_ENDPOINTS, BATCH))
+        telemetry.counter_inc("example.flushes")
 
     # Fleet-wide view: merge is elementwise on the bin arrays -- the same
     # operation lax.psum performs across a device mesh.
     fleet = region_a.merge(region_b)
 
-    q = np.asarray(fleet.get_quantile_values(QS))  # [N_ENDPOINTS, 4]
+    with telemetry.span("example.query_s"):
+        q = np.asarray(fleet.get_quantile_values(QS))  # [N_ENDPOINTS, 4]
     counts = np.asarray(fleet.count)
 
     print(f"endpoints: {N_ENDPOINTS}, samples/endpoint: {counts[0]:.0f}")
@@ -72,27 +98,63 @@ def main():
     print(f"worst p99: endpoint {worst} at {q[worst, 2]:.1f} ms")
 
     # Observability counters the device tier maintains for free:
-    # - the occupied-window plan the query just used (bytes scale with
-    #   occupancy: tight latency distributions read one 128-bin tile of
-    #   one store instead of every bin -- docs/DESIGN.md section 3b);
-    # - collapsed mass (values that fell off the window edges);
-    # - overflow risk (largest accumulator vs the f32 exactness ceiling).
-    from sketches_tpu import kernels
-
-    lo_w, n_w, w_t, with_neg = kernels.plan_state_window(
-        fleet.spec, fleet.state
-    )
-    print(
-        f"query window plan: {n_w * w_t} of"
-        f" {fleet.spec.n_bins // 128} column tiles,"
-        f" negative store {'read' if with_neg else 'skipped (empty)'}"
-    )
+    # collapsed mass (values that fell off the window edges) and overflow
+    # risk (largest accumulator vs the f32 exactness ceiling).
     collapsed = float(np.asarray(fleet.collapsed_fraction()).max())
     _, risk = fleet.overflow_risk()
     print(
         f"max collapsed fraction: {collapsed:.2e};"
         f" max overflow-risk fraction: {float(np.asarray(risk).max()):.2e}"
     )
+
+    # The library watching itself: every facade dispatch above landed in a
+    # self-sketch histogram, so the runtime's own p50/p99 carry the same
+    # relative-error guarantee as the endpoint latencies.
+    snap = telemetry.snapshot()
+    ingest_keys = [
+        k for k in snap["histograms"] if k.startswith("ingest_s")
+    ]
+    for k in ingest_keys:
+        h = snap["histograms"][k]
+        print(
+            f"self-sketch {k}: n={h['count']:.0f}"
+            f" p50={h['p50'] * 1e3:.2f} ms p99={h['p99'] * 1e3:.2f} ms"
+            f" (alpha={h['relative_accuracy']})"
+        )
+    print(
+        "telemetry: "
+        f"{len(snap['counters'])} counters, "
+        f"{len(snap['histograms'])} histograms, "
+        f"{snap['spans']['n_events']} trace events"
+    )
+
+    # Prometheus text exposition -- what a scrape endpoint would serve.
+    prom = telemetry.prometheus_text()
+    example_lines = [
+        ln for ln in prom.splitlines()
+        if "example_" in ln and not ln.startswith("#")
+    ]
+    print("prometheus exposition (example.* series):")
+    for ln in example_lines[:6]:
+        print(f"  {ln}")
+
+    # Chrome-trace export: load this file in chrome://tracing / perfetto
+    # to see the spans on per-thread tracks.
+    import json
+
+    trace = telemetry.chrome_trace()
+    out_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "latency_monitoring_trace.json"
+    )
+    try:
+        with open(out_path, "w") as f:
+            json.dump(trace, f)
+        print(
+            f"chrome trace: {len(trace['traceEvents'])} events ->"
+            f" {os.path.basename(out_path)}"
+        )
+    except OSError:
+        print("chrome trace: skipped (read-only checkout)")
 
     # Interop: any single endpoint's sketch can round-trip through the
     # reference-compatible protobuf wire format for other-language readers.

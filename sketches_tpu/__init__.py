@@ -14,7 +14,7 @@ Two execution tiers:
   or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
-from sketches_tpu import faults, resilience
+from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
@@ -52,7 +52,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.7.0"
+__version__ = "0.8.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -77,6 +77,8 @@ __all__ = [
     # Resilience layer (error taxonomy, fault injection, health ledger)
     "resilience",
     "faults",
+    # Telemetry layer (self-sketching metrics, spans, exporters)
+    "telemetry",
     "SketchError",
     "SketchValueError",
     "SpecError",

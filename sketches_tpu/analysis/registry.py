@@ -30,6 +30,7 @@ __all__ = [
     "NATIVE",
     "OVERLAP",
     "FAULTS",
+    "TELEMETRY",
     "REGISTRY",
     "declared",
     "get",
@@ -89,10 +90,24 @@ FAULTS = EnvVar(
     ),
 )
 
+#: Telemetry arming (``sketches_tpu.telemetry``).
+TELEMETRY = EnvVar(
+    name="SKETCHES_TPU_TELEMETRY",
+    default="0",
+    owner="sketches_tpu.telemetry",
+    doc=(
+        "Set to 1 to arm the self-sketching telemetry layer (metric"
+        " registry + trace spans); 0/unset leaves it off -- one bool"
+        " test per instrumented dispatch."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
-REGISTRY: Dict[str, EnvVar] = {v.name: v for v in (NATIVE, OVERLAP, FAULTS)}
+REGISTRY: Dict[str, EnvVar] = {
+    v.name: v for v in (NATIVE, OVERLAP, FAULTS, TELEMETRY)
+}
 
 
 def declared() -> Tuple[EnvVar, ...]:

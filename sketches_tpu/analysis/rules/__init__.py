@@ -24,11 +24,15 @@ engine-ladder       every engine ``choose_query_engine`` can return is a
 jnp-f64             no ``float64`` construction on jnp paths (f32-only
                     device tier)
 determinism         no ``time.time``-family wall-clock reads or unseeded
-                    ``np.random`` in library code
+                    ``np.random`` in library code (``telemetry.py`` is the
+                    one carved-out clock boundary)
 failure-docstring   every public ``__all__`` symbol documents its failure
                     modes
 host-callback       no ``pure_callback``/``io_callback``/``host_callback``
                     in library code (hot paths must not sync to host)
+telemetry-names     every telemetry metric/span name in the package is a
+                    string literal declared in ``telemetry.py``'s
+                    ``Metric`` inventory (no stringly-typed drift)
 ==================  ======================================================
 """
 
@@ -40,6 +44,7 @@ from sketches_tpu.analysis.rules import (  # noqa: F401  (import = register)
     engines,
     env_registry,
     raises,
+    telemetry_names,
 )
 
 __all__ = [
@@ -50,4 +55,5 @@ __all__ = [
     "engines",
     "env_registry",
     "raises",
+    "telemetry_names",
 ]

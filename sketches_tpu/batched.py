@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sketches_tpu import faults, resilience
+from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
 from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
 from sketches_tpu.resilience import SketchValueError, SpecError
@@ -1114,6 +1114,8 @@ class BatchedDDSketch:
         are inert padding (see :func:`add`); pass ``validate=True`` via
         :meth:`add_validated` to reject negative weights eagerly instead.
         """
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        _eng = "xla"
         values = jnp.asarray(values)
         if weights is not None:
             # Keep the weights' own dtype (the kernel casts to spec.dtype);
@@ -1147,6 +1149,7 @@ class BatchedDDSketch:
                 mask = jnp.asarray(self._pending_recenter_mask)
             self._auto_recenter_pending = False
             self._pending_recenter_mask = None
+            _eng = "recenter"
             self._stream_op("recenter_add", self._add_recentering, values, weights, mask)
             if armed_by_policy:
                 # Re-baseline the policy snapshots past the fold the armed
@@ -1173,6 +1176,7 @@ class BatchedDDSketch:
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_INGEST)
+                _eng = "pallas"
                 self._stream_op("add_pallas", self._add_pallas, values, weights)
             except Exception as e:
                 # Pallas ingest lost (lowering/compile failure or injected
@@ -1191,6 +1195,7 @@ class BatchedDDSketch:
                     repr(e),
                 )
                 try:
+                    _eng = "xla"
                     self._stream_op("add_xla", self._add_xla, values, weights)
                 except Exception as e2:
                     raise resilience.EngineUnavailable(
@@ -1200,6 +1205,11 @@ class BatchedDDSketch:
         else:
             self._stream_op("add_xla", self._add_xla, values, weights)
         self._invalidate_plans()
+        if _t0 is not None:
+            telemetry.finish_span(
+                "ingest_s", _t0, component="batched", engine=_eng
+            )
+            telemetry.counter_inc("batched.ingest_batches")
         return self
 
     def add_validated(self, values, weights=None) -> "BatchedDDSketch":
@@ -1350,7 +1360,13 @@ class BatchedDDSketch:
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
-                return fn(self.state, qs_arr)
+                _t0 = telemetry.clock() if telemetry._ACTIVE else None
+                out = fn(self.state, qs_arr)
+                if _t0 is not None:
+                    telemetry.finish_span(
+                        "query_s", _t0, component="batched", tier=tier
+                    )
+                return out
             except Exception as e:
                 if not self._demote_query(tier, e):
                     raise
@@ -1390,7 +1406,10 @@ class BatchedDDSketch:
             raise UnequalSketchParametersError(
                 "Cannot merge two batched sketches with different specs"
             )
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
         self._stream_op("merge_aligned", self._merge_body, other.state)
+        if _t0 is not None:
+            telemetry.finish_span("merge_s", _t0, component="batched")
         self._invalidate_plans()
         # A merge that brings mass populates the batch: a still-pending
         # first-batch auto-center would recenter away from that mass.  An

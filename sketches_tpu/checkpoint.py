@@ -37,7 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sketches_tpu import faults
+from sketches_tpu import faults, telemetry
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.resilience import CheckpointCorrupt
 
@@ -62,6 +62,7 @@ def _digest(spec_json: str, arrays: dict) -> str:
 def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
     """Write spec + state to ``path`` (npz; compressed, checksummed,
     atomically renamed into place)."""
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
     arrays = {name: np.asarray(jax.device_get(getattr(state, name)))
               for name in _FIELDS}
     spec_json = json.dumps(
@@ -99,6 +100,9 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        if _t0 is not None:
+            telemetry.finish_span("checkpoint.save_s", _t0)
+            telemetry.gauge_set("checkpoint.bytes", float(len(data)))
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -111,8 +115,9 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
     file, bad archive, checksum mismatch, missing members); a missing
     file stays ``FileNotFoundError``.
     """
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
     try:
-        return _restore_state_inner(path)
+        out = _restore_state_inner(path)
     except (FileNotFoundError, CheckpointCorrupt):
         raise
     except Exception as e:
@@ -120,6 +125,9 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             f"checkpoint {path!r} failed to restore"
             f" ({type(e).__name__}: {e})"
         ) from e
+    if _t0 is not None:
+        telemetry.finish_span("checkpoint.restore_s", _t0)
+    return out
 
 
 def _restore_state_inner(path: str) -> Tuple[SketchSpec, SketchState]:

@@ -23,7 +23,7 @@ import typing
 
 import numpy as np
 
-from sketches_tpu import faults, resilience
+from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import EngineUnavailable, SpecError
 
@@ -89,6 +89,7 @@ def _load() -> typing.Optional[ctypes.CDLL]:
             )
             return None
         last_error = None
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
         for attempt in range(_MAX_LOAD_ATTEMPTS):
             if attempt:
                 time.sleep(
@@ -97,6 +98,8 @@ def _load() -> typing.Optional[ctypes.CDLL]:
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.NATIVE_LOAD)
+                if _t0 is not None:
+                    telemetry.counter_inc("native.load_attempts")
                 if _stale():
                     subprocess.run(
                         ["make", "-C", _NATIVE_DIR],
@@ -105,6 +108,8 @@ def _load() -> typing.Optional[ctypes.CDLL]:
                         text=True,
                     )
                 _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                if _t0 is not None:
+                    telemetry.finish_span("native.load_s", _t0)
                 return _lib
             except (
                 OSError,

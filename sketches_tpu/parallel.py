@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sketches_tpu import faults, resilience
+from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.batched import (
     BatchedDDSketch,
     SketchSpec,
@@ -551,6 +551,7 @@ class DistributedDDSketch:
 
         Use ``weights == 0`` entries to pad ragged batches to a multiple.
         """
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
         values = jnp.asarray(values)
         if values.ndim == 1:
             values = values[:, None]
@@ -607,6 +608,11 @@ class DistributedDDSketch:
             self._policy_binned = np.asarray(
                 st.count - st.zero_count, np.float64
             )
+        if _t0 is not None:
+            telemetry.finish_span(
+                "ingest_s", _t0, component="distributed", engine="shard_map"
+            )
+            telemetry.counter_inc("distributed.ingest_batches")
         return self
 
     def merged_state(self) -> SketchState:
@@ -616,7 +622,10 @@ class DistributedDDSketch:
         one collective, not one each.
         """
         if self._merged_cache is None:
+            _t0 = telemetry.clock() if telemetry._ACTIVE else None
             self._merged_cache = self._fold(self.partials)
+            if _t0 is not None:
+                telemetry.finish_span("distributed.fold_s", _t0)
         return self._merged_cache
 
     def merge_partial(self, live_mask=None):
@@ -831,7 +840,14 @@ class DistributedDDSketch:
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
-                return fn(self.merged_state(), qs_arr)
+                st = self.merged_state()
+                _t0 = telemetry.clock() if telemetry._ACTIVE else None
+                out = fn(st, qs_arr)
+                if _t0 is not None:
+                    telemetry.finish_span(
+                        "query_s", _t0, component="distributed", tier=tier
+                    )
+                return out
             except Exception as e:
                 nxt = resilience.demote_query_tier(self._query_disabled, tier)
                 if nxt is None:
@@ -871,6 +887,7 @@ class DistributedDDSketch:
             )
         a_st = self.merged_state()
         b_st = other.merged_state()
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
         a_binned = (a_st.count - a_st.zero_count) > 0
         target = jnp.where(
             a_binned, a_st.key_offset, b_st.key_offset
@@ -878,6 +895,8 @@ class DistributedDDSketch:
         self._partials = self._recenter_partials(self.partials, target)
         other_aligned = self._recenter_partials_pure(other.partials, target)
         self._partials = self._merge_partials(self._partials, other_aligned)
+        if _t0 is not None:
+            telemetry.finish_span("merge_s", _t0, component="distributed")
         self._merged_cache = None
         self._invalidate_plans()
         # A merge that brings mass populates the batch: a still-pending

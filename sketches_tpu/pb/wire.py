@@ -41,7 +41,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from sketches_tpu import faults, resilience
+from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.batched import (
     SketchSpec,
     SketchState,
@@ -158,6 +158,8 @@ def state_to_bytes(spec: SketchSpec, state: SketchState) -> List[bytes]:
     bridge's ``to_proto(...).SerializeToString()``."""
     import jax
 
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
+
     bins_pos, bins_neg, zero, koff = (
         np.asarray(a)
         for a in jax.device_get(
@@ -194,6 +196,9 @@ def state_to_bytes(spec: SketchSpec, state: SketchState) -> List[bytes]:
         if has_zero[i]:
             parts.append(b"\x21" + struct.pack("<d", zero64[i]))
         blobs.append(b"".join(parts))
+    if _t0 is not None:
+        telemetry.finish_span("wire.encode_s", _t0)
+        telemetry.counter_inc("wire.blobs_encoded", float(len(blobs)))
     return blobs
 
 
@@ -604,6 +609,7 @@ def bytes_to_state(
             f"Unknown errors mode {errors!r}; expected 'raise' or"
             " 'quarantine'"
         )
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
     report = QuarantineReport(total=len(blobs)) if errors == "quarantine" else None
     dec = _Decoder(spec, len(blobs))
     expected_mapping = _mapping_field(spec)
@@ -687,13 +693,21 @@ def bytes_to_state(
         zv = np.fromiter((z[1] for z in zeros), np.float64, len(zeros))
         dec.zero[zi] = zv
         dec.count[zi] += zv
+    state = dec.finish()
+    if _t0 is not None:
+        telemetry.finish_span("wire.decode_s", _t0, errors=errors)
+        telemetry.counter_inc("wire.blobs_decoded", float(len(blobs)))
     if report is None:
-        return dec.finish()
+        return state
     if report.n_quarantined:
         resilience.bump("wire.quarantined", report.n_quarantined)
         for kind, n in report.counters.items():
             resilience.bump(f"wire.quarantined.{kind}", n)
-    return dec.finish(), report
+        if telemetry._ACTIVE:
+            telemetry.counter_inc(
+                "wire.blobs_quarantined", float(report.n_quarantined)
+            )
+    return state, report
 
 
 def protos_to_state(

@@ -29,18 +29,21 @@ next-slower-but-simpler tier and is recorded); ingest degrades
 tier computes the same answer -- degradation costs latency, never
 correctness.
 
-This module imports nothing from the rest of the package (it sits below
-everything), so any module may import it without cycles.
+This module sits near the bottom of the package: it imports only
+:mod:`sketches_tpu.telemetry` (itself stdlib + the env registry), which
+owns the process's wall clock (ledger timestamps) and mirrors every
+downgrade event into the metrics layer when telemetry is armed.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
+
+from sketches_tpu import telemetry
 
 __all__ = [
     "SketchError",
@@ -157,16 +160,28 @@ _counters: Dict[str, float] = {}
 def record_downgrade(
     component: str, from_tier: str, to_tier: str, reason: str = ""
 ) -> DowngradeEvent:
-    """Record one degradation step into the process-wide health ledger."""
+    """Record one degradation step into the process-wide health ledger.
+
+    Never fails the caller: a downgrade is already a failure being
+    survived.  Ledger timestamps are operator-facing observability, not
+    replay state (nothing branches on them); the wall clock lives in
+    ``telemetry.wall_time`` -- the package's one clock boundary -- and
+    armed telemetry mirrors the event as a ``resilience.downgrade``
+    counter + trace instant so ledger and metrics snapshot agree.
+    """
     ev = DowngradeEvent(
-        # Ledger timestamps are operator-facing observability, not replay
-        # state: nothing branches on them.  sketchlint: ignore[determinism]
-        component, from_tier, to_tier, str(reason)[:500], time.time()
+        component, from_tier, to_tier, str(reason)[:500],
+        telemetry.wall_time(),
     )
     with _lock:
         _events.append(ev)
         _tiers[component] = to_tier
         _counters["downgrades"] = _counters.get("downgrades", 0) + 1
+    if telemetry._ACTIVE:
+        telemetry.event(
+            "resilience.downgrade",
+            component=component, from_tier=from_tier, to_tier=to_tier,
+        )
     return ev
 
 

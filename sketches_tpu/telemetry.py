@@ -1,0 +1,816 @@
+"""Self-sketching runtime telemetry: the library instruments itself with
+its own sketches.
+
+DDSketch exists for production latency monitoring (PAPER.md; the
+high-cardinality-aggregation use case behind Moments sketch,
+arXiv:1803.01969, and UDDSketch, arXiv:2004.08604), so this repo's own
+runtime dogfoods it: every timed section feeds a **host-tier DDSketch
+with a LogarithmicMapping** (``HISTOGRAM_REL_ACC`` alpha), which means
+the p50/p99 a snapshot reports carry the paper's relative-error
+guarantee rather than a bucket boundary's.  Three surfaces:
+
+* **Metric registry** -- process-wide counters, gauges, and
+  sketch-backed latency histograms, keyed by a **declared inventory**
+  (:data:`METRICS`).  Library code may only use names declared here
+  (enforced statically by the sketchlint ``telemetry-names`` rule and at
+  runtime by :func:`counter_inc`/:func:`observe`); user code extends the
+  inventory with :func:`declare`.
+* **Trace spans** -- :func:`span`/:func:`finish_span` record
+  Chrome-trace/perfetto ``X`` events (the device-track conventions
+  ``bench.py``'s ``device_query_pcts`` parses) with thread-safe nesting
+  (per-thread track, bounded ring, drops counted -- never unbounded
+  growth), and feed the span's histogram on exit.
+* **Exporters** -- :func:`snapshot` (JSON-safe dict, with the
+  ``resilience.health()`` ledger bridged in so demotion counters and
+  metrics always agree), :func:`prometheus_text` (text exposition;
+  histograms as summaries), :func:`chrome_trace` (load it in
+  ``chrome://tracing`` / perfetto).
+
+Arming: OFF by default.  ``SKETCHES_TPU_TELEMETRY=1`` (declared in
+``analysis/registry.py``) arms at process start; :func:`enable` /
+:func:`disable` arm programmatically.  Cost discipline mirrors
+``faults``: every instrumented seam guards on ``telemetry._ACTIVE``, so
+the disarmed layer costs one attribute read + bool test per *dispatch*
+-- no clock read, no allocation (tested in ``tests/test_telemetry.py``).
+Wall-clock reads live ONLY in this module (:func:`clock` /
+:func:`wall_time`): the sketchlint ``determinism`` rule carves out
+``telemetry.py`` and keeps flagging clocks everywhere else.
+
+CLI: ``python -m sketches_tpu.telemetry --check-bench OLD NEW`` is the
+bench regression gate -- it compares two ``bench.py`` summary documents
+(e.g. the checked-in ``BENCH_local_r*.json``) metric by metric against
+per-metric thresholds and exits non-zero on regression.
+
+Failure modes: recording against an undeclared metric name (or the
+wrong kind) raises ``SketchValueError`` -- stringly-typed drift is
+refused, not collected; a full trace ring drops the newest events and
+counts them (``snapshot()['spans']['dropped']``); ``--check-bench``
+exits 1 on any regressed metric and 2 when the documents share no
+comparable metric at all (wrong files beat a silent pass).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from sketches_tpu.analysis import registry
+
+__all__ = [
+    "TELEMETRY_ENV",
+    "HISTOGRAM_REL_ACC",
+    "Metric",
+    "METRICS",
+    "declare",
+    "enable",
+    "disable",
+    "enabled",
+    "reset",
+    "clock",
+    "wall_time",
+    "counter_inc",
+    "gauge_set",
+    "observe",
+    "finish_span",
+    "span",
+    "event",
+    "snapshot",
+    "prometheus_text",
+    "chrome_trace",
+    "check_bench",
+    "main",
+]
+
+#: Declared in ``analysis/registry.py`` (the kill-switch inventory);
+#: this alias keeps the import-path convention of the other levers.
+TELEMETRY_ENV = registry.TELEMETRY.name
+
+#: Relative accuracy of every self-sketch histogram: quantiles a
+#: snapshot reports are within 1% of the recorded durations' exact
+#: quantiles (the DDSketch contract, applied to ourselves).
+HISTOGRAM_REL_ACC = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    """One declared metric: its name, kind, owning module, and doc.
+
+    ``kind`` is ``"counter"`` (monotone float), ``"gauge"`` (last write
+    wins), or ``"histogram"`` (DDSketch-backed distribution of seconds;
+    spans feed these).  Recording against a name whose declared kind
+    does not match the API used raises ``SketchValueError``.
+    """
+
+    name: str
+    kind: str
+    owner: str
+    doc: str
+
+
+# The library's metric inventory.  The sketchlint ``telemetry-names``
+# rule parses these ``Metric(...)`` declarations and requires every
+# telemetry call in the package to use one of them (no stringly-typed
+# drift); the README "Observability" table documents the same set.
+_DECLARED = (
+    Metric("batched.ingest_batches", "counter", "sketches_tpu.batched",
+           "Batches ingested through BatchedDDSketch.add."),
+    Metric("distributed.ingest_batches", "counter", "sketches_tpu.parallel",
+           "Batches ingested through DistributedDDSketch.add."),
+    Metric("scalar.values", "counter", "sketches_tpu.ddsketch",
+           "Values flushed through the JaxDDSketch scalar/bulk paths."),
+    Metric("wire.blobs_encoded", "counter", "sketches_tpu.pb.wire",
+           "Wire blobs produced by state_to_bytes."),
+    Metric("wire.blobs_decoded", "counter", "sketches_tpu.pb.wire",
+           "Wire blobs admitted to bytes_to_state (quarantined included)."),
+    Metric("wire.blobs_quarantined", "counter", "sketches_tpu.pb.wire",
+           "Blobs isolated by a quarantine-mode bulk decode."),
+    Metric("native.load_attempts", "counter", "sketches_tpu.native",
+           "Native-engine build/load attempts (retries included)."),
+    Metric("resilience.downgrade", "counter", "sketches_tpu.resilience",
+           "Downgrade events recorded in the resilience health ledger."),
+    Metric("checkpoint.bytes", "gauge", "sketches_tpu.checkpoint",
+           "Size of the most recently written checkpoint, in bytes."),
+    Metric("ingest_s", "histogram", "sketches_tpu.batched",
+           "Facade ingest dispatch wall time (labels: component, engine)."),
+    Metric("query_s", "histogram", "sketches_tpu.batched",
+           "Query dispatch wall time, labeled by the resolved engine tier"
+           " (labels: component, tier)."),
+    Metric("merge_s", "histogram", "sketches_tpu.batched",
+           "Facade merge dispatch wall time (label: component)."),
+    Metric("scalar.ingest_s", "histogram", "sketches_tpu.ddsketch",
+           "JaxDDSketch flush/add_many wall time (label: path)."),
+    Metric("distributed.fold_s", "histogram", "sketches_tpu.parallel",
+           "psum fold of the distributed partials (cache misses only)."),
+    Metric("wire.encode_s", "histogram", "sketches_tpu.pb.wire",
+           "Bulk wire encode wall time per batch."),
+    Metric("wire.decode_s", "histogram", "sketches_tpu.pb.wire",
+           "Bulk wire decode wall time per batch."),
+    Metric("native.load_s", "histogram", "sketches_tpu.native",
+           "Native-engine build+load wall time (successful loads)."),
+    Metric("checkpoint.save_s", "histogram", "sketches_tpu.checkpoint",
+           "Checkpoint serialize+fsync+rename wall time."),
+    Metric("checkpoint.restore_s", "histogram", "sketches_tpu.checkpoint",
+           "Checkpoint load+validate wall time."),
+)
+
+#: Every declared metric by name (static inventory + runtime
+#: :func:`declare` extensions).
+METRICS: Dict[str, Metric] = {m.name: m for m in _DECLARED}
+
+_VALID_KINDS = ("counter", "gauge", "histogram")
+
+_lock = threading.Lock()
+
+#: Fast-path guard: instrumented seams check this module flag before
+#: doing any telemetry work, so the disarmed layer costs one bool test.
+_ACTIVE = registry.enabled(registry.TELEMETRY)
+
+# Trace timebase: ts fields are microseconds since this process epoch.
+# The two module-level clock reads below (and the clock()/wall_time()
+# bodies) are the ONLY wall-clock reads in the package -- the
+# determinism rule's telemetry.py carve-out covers exactly this file.
+_epoch_pc = time.perf_counter()
+_epoch_wall = time.time()
+
+_MAX_EVENTS = 65536
+
+# Keyed by (name, ((label, value), ...)) -- labels canonically sorted.
+_Key = Tuple[str, Tuple[Tuple[str, str], ...]]
+_counters: Dict[_Key, float] = {}
+_gauges: Dict[_Key, float] = {}
+_hists: Dict[_Key, "_Hist"] = {}
+_events: List[dict] = []
+_events_dropped = 0
+_tids: Dict[int, int] = {}
+
+
+def _raise_value_error(msg: str) -> None:
+    # Lazy import: resilience imports telemetry at module load (for the
+    # ledger clock), so the taxonomy root is reached at call time only.
+    from sketches_tpu.resilience import SketchValueError
+
+    raise SketchValueError(msg)
+
+
+def declare(name: str, kind: str, doc: str, owner: str = "user") -> Metric:
+    """Register a user-space metric (examples, applications, tests).
+
+    Library code must use the static inventory instead (the sketchlint
+    ``telemetry-names`` rule refuses in-package ``declare`` calls).
+    Raises ``SketchValueError`` on an invalid kind; re-declaring an
+    existing name with a different kind raises, an identical
+    re-declaration is a no-op.
+    """
+    if kind not in _VALID_KINDS:
+        _raise_value_error(
+            f"Unknown metric kind {kind!r}; expected one of {_VALID_KINDS}"
+        )
+    with _lock:
+        prev = METRICS.get(name)
+        if prev is not None:
+            if prev.kind != kind:
+                _raise_value_error(
+                    f"metric {name!r} already declared with kind"
+                    f" {prev.kind!r}"
+                )
+            return prev
+        m = Metric(name, kind, owner, doc)
+        METRICS[name] = m
+        return m
+
+
+def _metric(name: str, kind: str) -> Metric:
+    m = METRICS.get(name)
+    if m is None:
+        _raise_value_error(
+            f"undeclared telemetry metric {name!r}; library metrics belong"
+            " in telemetry.METRICS, user metrics go through"
+            " telemetry.declare()"
+        )
+    if m.kind != kind:
+        _raise_value_error(
+            f"telemetry metric {name!r} is a {m.kind}, not a {kind}"
+        )
+    return m
+
+
+def _key(name: str, labels: Dict[str, Any]) -> _Key:
+    return (
+        name,
+        tuple(sorted((k, str(v)) for k, v in labels.items())),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Arming
+# ---------------------------------------------------------------------------
+
+
+def enable(on: bool = True) -> None:
+    """Arm (or, with ``on=False``, disarm) the telemetry layer.
+
+    Never raises; the pre-existing metric state is kept (use
+    :func:`reset` to clear it).
+    """
+    global _ACTIVE
+    _ACTIVE = bool(on)
+
+
+def disable() -> None:
+    """Disarm the telemetry layer (instrumented seams go back to one
+    bool test per dispatch; recorded state is kept, never lost)."""
+    enable(False)
+
+
+def enabled() -> bool:
+    """Whether the layer is armed (env switch or :func:`enable`);
+    False -- the default -- means no seam records anything."""
+    return _ACTIVE
+
+
+def reset() -> None:
+    """Clear every counter/gauge/histogram/trace event (test isolation
+    hook; runtime-declared metrics stay declared).  Never raises."""
+    global _events_dropped
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _events.clear()
+        _tids.clear()
+        _events_dropped = 0
+
+
+# ---------------------------------------------------------------------------
+# Clocks (the package's only wall-clock reads -- see module docstring)
+# ---------------------------------------------------------------------------
+
+
+def clock() -> float:
+    """Monotonic seconds (``time.perf_counter``): span/duration timebase.
+
+    The one sanctioned monotonic read in the package -- instrumented
+    seams call this instead of touching ``time`` (which the determinism
+    lint would rightly flag as a replay hazard).  Never raises.
+    """
+    return time.perf_counter()
+
+
+def wall_time() -> float:
+    """Wall-clock seconds since the epoch (``time.time``).
+
+    Operator-facing timestamps only (the resilience ledger's event
+    times); nothing may branch on it.  Never raises.
+    """
+    return time.time()
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+
+class _Hist:
+    """One histogram: a host-tier DDSketch plus exact min/max.
+
+    The sketch import is lazy (first armed observation), so importing
+    telemetry never pays for the sketch stack; count/sum come from the
+    sketch's own (exact, f64) bookkeeping.  Failure modes follow the
+    sketch's: quantiles of an empty histogram read as None/NaN.
+    """
+
+    __slots__ = ("sketch", "min", "max")
+
+    def __init__(self):
+        from sketches_tpu.ddsketch import DDSketch
+
+        self.sketch = DDSketch(HISTOGRAM_REL_ACC)
+        self.min = math.inf
+        self.max = -math.inf
+
+    def add(self, value: float) -> None:
+        self.sketch.add(value)
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def summary(self) -> dict:
+        sk = self.sketch
+        out = {
+            "count": sk.count,
+            "sum": sk.sum,
+            "min": None if math.isinf(self.min) else self.min,
+            "max": None if math.isinf(self.max) else self.max,
+            "relative_accuracy": HISTOGRAM_REL_ACC,
+        }
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                         (0.999, "p999")):
+            out[label] = sk.get_quantile_value(q)
+        return out
+
+
+def counter_inc(name: str, n: float = 1.0, **labels) -> None:
+    """Add ``n`` to counter ``name`` (no-op while disarmed).
+
+    Raises ``SketchValueError`` for an undeclared name or a non-counter
+    metric.
+    """
+    if not _ACTIVE:
+        return
+    _metric(name, "counter")
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0.0) + n
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    """Set gauge ``name`` (last write wins; no-op while disarmed).
+
+    Raises ``SketchValueError`` for an undeclared name or a non-gauge
+    metric.
+    """
+    if not _ACTIVE:
+        return
+    _metric(name, "gauge")
+    with _lock:
+        _gauges[_key(name, labels)] = float(value)
+
+
+def observe(name: str, seconds: float, **labels) -> None:
+    """Feed one duration into histogram ``name`` (no-op while disarmed).
+
+    Raises ``SketchValueError`` for an undeclared name or a
+    non-histogram metric; the value lands in a DDSketch, so snapshot
+    quantiles are within ``HISTOGRAM_REL_ACC`` of exact.
+    """
+    if not _ACTIVE:
+        return
+    _metric(name, "histogram")
+    k = _key(name, labels)
+    with _lock:
+        h = _hists.get(k)
+        if h is None:
+            h = _hists[k] = _Hist()
+        h.add(float(seconds))
+
+
+def _tid() -> int:
+    ident = threading.get_ident()
+    t = _tids.get(ident)
+    if t is None:
+        t = _tids[ident] = len(_tids) + 1
+    return t
+
+
+def _append_event(ev: dict) -> None:
+    global _events_dropped
+    if len(_events) < _MAX_EVENTS:
+        _events.append(ev)
+    else:
+        _events_dropped += 1
+
+
+def finish_span(name: str, t0: float, **labels) -> float:
+    """Close a span opened at ``t0 = telemetry.clock()`` -> duration.
+
+    Feeds histogram ``name`` and appends one Chrome-trace ``X`` event
+    (per-thread track, bounded ring).  The explicit-``t0`` form is the
+    hot-seam idiom: the seam pays ONE bool test while disarmed
+    (``t0 = telemetry.clock() if telemetry._ACTIVE else None``) instead
+    of a context-manager allocation.  Raises ``SketchValueError`` for an
+    undeclared name; while disarmed it records nothing and returns 0.0.
+    """
+    if not _ACTIVE:
+        return 0.0
+    _metric(name, "histogram")
+    now = clock()
+    dur = max(now - t0, 0.0)
+    k = _key(name, labels)
+    with _lock:
+        h = _hists.get(k)
+        if h is None:
+            h = _hists[k] = _Hist()
+        h.add(dur)
+        _append_event(
+            {
+                "name": name,
+                "cat": "sketches_tpu",
+                "ph": "X",
+                "ts": (t0 - _epoch_pc) * 1e6,
+                "dur": dur * 1e6,
+                "pid": 1,
+                "tid": _tid(),
+                "args": {k2: str(v) for k2, v in labels.items()},
+            }
+        )
+    return dur
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "labels", "t0")
+
+    def __init__(self, name: str, labels: Dict[str, Any]):
+        self.name = name
+        self.labels = labels
+
+    def __enter__(self) -> "_Span":
+        self.t0 = clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        finish_span(self.name, self.t0, **self.labels)
+        return False
+
+
+def span(name: str, **labels):
+    """Context manager timing a section into histogram ``name``.
+
+    Nest freely across threads: each thread renders as its own trace
+    track, and nesting shows as stacked ``X`` events.  Disarmed, it
+    returns a shared no-op and records nothing; the name check (raises
+    ``SketchValueError`` when undeclared) runs at exit via
+    :func:`finish_span`, after the timed section.
+    """
+    if not _ACTIVE:
+        return _NOOP_SPAN
+    return _Span(name, labels)
+
+
+def event(name: str, **labels) -> None:
+    """Record an instant: counter ``name`` += 1 plus one trace ``i`` event.
+
+    The bridge idiom for discrete occurrences (resilience downgrades).
+    Raises ``SketchValueError`` for an undeclared/non-counter name;
+    no-op while disarmed.
+    """
+    if not _ACTIVE:
+        return
+    _metric(name, "counter")
+    k = _key(name, labels)
+    with _lock:
+        _counters[k] = _counters.get(k, 0.0) + 1.0
+        _append_event(
+            {
+                "name": name,
+                "cat": "sketches_tpu",
+                "ph": "i",
+                "s": "t",
+                "ts": (clock() - _epoch_pc) * 1e6,
+                "pid": 1,
+                "tid": _tid(),
+                "args": {k2: str(v) for k2, v in labels.items()},
+            }
+        )
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+
+def _render_key(k: _Key) -> str:
+    name, labels = k
+    if not labels:
+        return name
+    inner = ",".join(f'{lk}="{lv}"' for lk, lv in labels)
+    return f"{name}{{{inner}}}"
+
+
+def snapshot() -> dict:
+    """JSON-safe snapshot of every metric plus the resilience ledger.
+
+    ``resilience.health()`` rides along verbatim under ``"resilience"``,
+    so demotion counters and the ledger can never disagree in one
+    artifact; an empty snapshot (no counters, no histograms) is the
+    disarmed/idle steady state, not an error.
+    """
+    with _lock:
+        counters = {_render_key(k): v for k, v in _counters.items()}
+        gauges = {_render_key(k): v for k, v in _gauges.items()}
+        hists = {_render_key(k): h.summary() for k, h in _hists.items()}
+        spans = {"n_events": len(_events), "dropped": _events_dropped}
+    from sketches_tpu import resilience
+
+    return {
+        "enabled": _ACTIVE,
+        "histogram_relative_accuracy": HISTOGRAM_REL_ACC,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+        "spans": spans,
+        "resilience": resilience.health(),
+    }
+
+
+def _prom_name(name: str) -> str:
+    base = name.replace(".", "_").replace("-", "_")
+    if base.endswith("_s"):
+        base = base[:-2] + "_seconds"
+    return "sketches_tpu_" + base
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text() -> str:
+    """Prometheus text exposition of the current metrics.
+
+    Counters export with a ``_total`` suffix, histograms as summaries
+    (``quantile`` label series + ``_sum``/``_count``), all under the
+    ``sketches_tpu_`` prefix.  An empty exposition is the disarmed/idle
+    steady state; parse failures are the consumer's to report.
+    """
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        hists = {k: h.summary() for k, h in _hists.items()}
+    lines: List[str] = []
+    seen_header = set()
+
+    def header(name: str, prom: str, mtype: str) -> None:
+        if prom in seen_header:
+            return
+        seen_header.add(prom)
+        m = METRICS.get(name)
+        if m is not None:
+            lines.append(f"# HELP {prom} {m.doc}")
+        lines.append(f"# TYPE {prom} {mtype}")
+
+    for (name, labels), v in sorted(counters.items()):
+        prom = _prom_name(name) + "_total"
+        header(name, prom, "counter")
+        lines.append(f"{prom}{_prom_labels(labels)} {v:g}")
+    for (name, labels), v in sorted(gauges.items()):
+        prom = _prom_name(name)
+        header(name, prom, "gauge")
+        lines.append(f"{prom}{_prom_labels(labels)} {v:g}")
+    for (name, labels), s in sorted(hists.items()):
+        prom = _prom_name(name)
+        header(name, prom, "summary")
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                         (0.999, "p999")):
+            val = s[label]
+            if val is None:
+                continue
+            qlabel = 'quantile="%g"' % q
+            lines.append(f"{prom}{_prom_labels(labels, qlabel)} {val:g}")
+        lines.append(f"{prom}_sum{_prom_labels(labels)} {s['sum']:g}")
+        lines.append(f"{prom}_count{_prom_labels(labels)} {s['count']:g}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def chrome_trace() -> dict:
+    """Chrome-trace/perfetto event JSON of the recorded spans.
+
+    Same ``traceEvents`` conventions ``bench.py`` parses from the TPU
+    runtime (``process_name``/``thread_name`` metadata + ``X`` duration
+    events), so one viewer serves both.  An empty event list is the
+    disarmed/idle steady state.
+    """
+    with _lock:
+        events = list(_events)
+        tids = dict(_tids)
+    meta: List[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "sketches_tpu telemetry"},
+        }
+    ]
+    for ident, t in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": t,
+                "args": {"name": f"thread-{ident}"},
+            }
+        )
+    return {"displayTimeUnit": "ms", "traceEvents": meta + events}
+
+
+# ---------------------------------------------------------------------------
+# Bench regression gate
+# ---------------------------------------------------------------------------
+
+#: (dot.path into the bench summary document, direction, tolerance).
+#: ``higher`` metrics regress when new < old * (1 - tol); ``lower``
+#: (latency) metrics regress when new > old * (1 + tol).  Tolerances are
+#: per-metric noise budgets: device-sustained rates are tight, host-timed
+#: loops (Python/serde) breathe more run to run.
+BENCH_GATE: Tuple[Tuple[str, str, float], ...] = (
+    ("value", "higher", 0.15),
+    ("configs.c0_host_python.add_per_s", "higher", 0.30),
+    ("configs.c0_host_native.add_per_s", "higher", 0.30),
+    ("configs.c0_jax_scalar.add_per_s", "higher", 0.30),
+    ("configs.c0_jax_scalar.add_many_per_s", "higher", 0.30),
+    ("configs.c1_10k_streams.ingest_fused_per_s", "higher", 0.15),
+    ("configs.c1_10k_streams.ingest_dispatch_per_s", "higher", 0.15),
+    ("configs.c1_10k_streams.query_p50_s", "lower", 0.30),
+    ("configs.c2_c4_1m_streams_cubic_collapsing.ingest_fused_per_s",
+     "higher", 0.15),
+    ("configs.c2s_shard_query_131k.worst_mixed_sign.query_sustained_s",
+     "lower", 0.30),
+    ("configs.c2s_shard_query_131k.tight_telemetry.query_sustained_s",
+     "lower", 0.30),
+    ("configs.c2s_shard_query_131k.worst_mixed_sign.device_query.p50_s",
+     "lower", 0.25),
+    ("configs.c2s_shard_query_131k.tight_telemetry.device_query.p50_s",
+     "lower", 0.25),
+    ("configs.c2s_shard_query_131k.merge_per_shard_s", "lower", 0.30),
+    ("configs.serde_bulk.to_bytes_s", "lower", 0.40),
+    ("configs.serde_bulk.from_bytes_s", "lower", 0.40),
+)
+
+
+def _lookup(doc: Any, path: str) -> Optional[float]:
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def check_bench(
+    old_doc: dict, new_doc: dict, tolerance: Optional[float] = None
+) -> Tuple[List[str], int, int]:
+    """Compare two bench summary documents -> (report lines, n_regressed,
+    n_compared).
+
+    Walks :data:`BENCH_GATE`; metrics absent from either document are
+    skipped (configs legitimately come and go), so callers must treat
+    ``n_compared == 0`` as a failure in its own right -- two
+    wrong-shaped files would otherwise "pass" vacuously.
+    """
+    lines: List[str] = []
+    regressed = compared = 0
+    for path, direction, tol in BENCH_GATE:
+        if tolerance is not None:
+            tol = tolerance
+        old = _lookup(old_doc, path)
+        new = _lookup(new_doc, path)
+        if old is None or new is None or old == 0:
+            continue
+        compared += 1
+        ratio = new / old
+        if direction == "higher":
+            bad = ratio < 1.0 - tol
+            arrow = "throughput"
+        else:
+            bad = ratio > 1.0 + tol
+            arrow = "latency"
+        verdict = "REGRESSED" if bad else "ok"
+        if bad:
+            regressed += 1
+        lines.append(
+            f"{verdict:>9}  {path}: {old:g} -> {new:g}"
+            f" (x{ratio:.3f}, {arrow}, tol {tol:.0%})"
+        )
+    return lines, regressed, compared
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry point: the bench regression gate (and snapshot dumps).
+
+    Exit codes: 0 clean, 1 on any regressed metric, 2 when nothing was
+    comparable (wrong files must not pass silently).
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sketches_tpu.telemetry",
+        description="telemetry utilities: bench regression gate,"
+        " snapshot dumps",
+    )
+    parser.add_argument(
+        "--check-bench",
+        nargs=2,
+        metavar=("OLD", "NEW"),
+        help="compare two bench.py summary JSONs (e.g. BENCH_local_r04.json"
+        " BENCH_local_r05.json); non-zero exit on regression",
+    )
+    parser.add_argument(
+        "--tolerance",
+        type=float,
+        default=None,
+        help="override every per-metric tolerance with one fraction",
+    )
+    parser.add_argument(
+        "--snapshot",
+        metavar="PATH",
+        default=None,
+        help="write the current process's JSON snapshot to PATH",
+    )
+    parser.add_argument(
+        "--prometheus",
+        metavar="PATH",
+        default=None,
+        help="write the current process's Prometheus exposition to PATH",
+    )
+    args = parser.parse_args(argv)
+
+    if args.snapshot:
+        with open(args.snapshot, "w", encoding="utf-8") as f:
+            json.dump(snapshot(), f, indent=1, sort_keys=True)
+            f.write("\n")
+    if args.prometheus:
+        with open(args.prometheus, "w", encoding="utf-8") as f:
+            f.write(prometheus_text())
+    if not args.check_bench:
+        if args.snapshot or args.prometheus:
+            return 0
+        parser.print_usage()
+        return 2
+
+    old_path, new_path = args.check_bench
+    with open(old_path, "r", encoding="utf-8") as f:
+        old_doc = json.load(f)
+    with open(new_path, "r", encoding="utf-8") as f:
+        new_doc = json.load(f)
+    lines, regressed, compared = check_bench(
+        old_doc, new_doc, tolerance=args.tolerance
+    )
+    for line in lines:
+        print(line)
+    if compared == 0:
+        print(
+            "check-bench: no comparable metric between the two documents"
+            " (wrong files?)"
+        )
+        return 2
+    if regressed:
+        print(f"check-bench: {regressed}/{compared} metric(s) REGRESSED")
+        return 1
+    print(f"check-bench: {compared} metric(s) within tolerance")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

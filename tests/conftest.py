@@ -24,3 +24,27 @@ import jax  # noqa: E402  (after env setup by design)
 jax.config.update("jax_platforms", "cpu")
 # NOTE: x64 stays disabled -- the device tier is designed for f32/bf16 (TPU),
 # and tests must exercise the same numerics the hardware will.
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Telemetry-armed runs (SKETCHES_TPU_TELEMETRY=1, the CI telemetry
+    job) leave the whole suite's self-sketched snapshot as an artifact:
+    TELEMETRY_SNAPSHOT_PATH gets the Prometheus exposition, plus a
+    ``.json`` sibling with the full snapshot (resilience ledger bridged
+    in).  Disarmed runs write nothing."""
+    path = os.environ.get("TELEMETRY_SNAPSHOT_PATH")
+    if not path:
+        return
+    try:
+        import json
+
+        from sketches_tpu import telemetry
+    except Exception:
+        return
+    if not telemetry.enabled():
+        return
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(telemetry.prometheus_text())
+    with open(path + ".json", "w", encoding="utf-8") as f:
+        json.dump(telemetry.snapshot(), f, indent=1, sort_keys=True)
+        f.write("\n")

@@ -254,6 +254,82 @@ class TestDeterminism:
         })
         assert run_lint(root, only=["determinism"]) == []
 
+    def test_telemetry_clock_carveout(self, tmp_path):
+        """telemetry.py is the ONE file allowed to read wall clocks (the
+        explicit rule carve-out replacing inline suppressions); the same
+        read in any other module still fires, and the carve-out does NOT
+        extend to unseeded RNG."""
+        clocky = "import time\ndef f():\n    return time.perf_counter()\n"
+        root = make_pkg(tmp_path, {
+            "telemetry.py": clocky,
+            "mod.py": clocky,
+        })
+        found = run_lint(root, only=["determinism"])
+        assert len(found) == 1
+        assert found[0].path.endswith("mod.py")
+        rng_root = make_pkg(tmp_path / "rng", {
+            "telemetry.py": (
+                "import numpy as np\n"
+                "def f():\n"
+                "    return np.random.rand(3)\n"
+            ),
+        })
+        assert len(run_lint(rng_root, only=["determinism"])) == 1
+
+
+class TestTelemetryNames:
+    INVENTORY = (
+        "class Metric:\n"
+        "    def __init__(self, name, kind, owner, doc):\n"
+        "        self.name = name\n"
+        "METRICS = {m.name: m for m in (\n"
+        "    Metric('query_s', 'histogram', 'pkg', 'doc'),\n"
+        "    Metric(name='hits', kind='counter', owner='pkg', doc='doc'),\n"
+        ")}\n"
+    )
+
+    def test_flags_undeclared_computed_and_declare(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "telemetry.py": self.INVENTORY,
+            "mod.py": (
+                "from pkg import telemetry\n"
+                "def f(name):\n"
+                "    telemetry.counter_inc('rogue.metric')\n"
+                "    telemetry.observe(name, 1.0)\n"
+                "    telemetry.declare('my.metric', 'counter', 'd')\n"
+            ),
+        })
+        found = run_lint(root, only=["telemetry-names"])
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 3
+        assert "not declared" in msgs
+        assert "string literal" in msgs
+        assert "declare() in library code" in msgs
+
+    def test_declared_literals_are_clean(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "telemetry.py": self.INVENTORY,
+            "mod.py": (
+                "from pkg import telemetry\n"
+                "def f():\n"
+                "    telemetry.counter_inc('hits', 2.0)\n"
+                "    with telemetry.span('query_s', tier='xla'):\n"
+                "        pass\n"
+                "    telemetry.finish_span('query_s', 0.0)\n"
+            ),
+        })
+        assert run_lint(root, only=["telemetry-names"]) == []
+
+    def test_telemetry_module_itself_exempt(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "telemetry.py": (
+                self.INVENTORY
+                + "def observe(name, v):\n"
+                "    pass\n"
+            ),
+        })
+        assert run_lint(root, only=["telemetry-names"]) == []
+
 
 class TestFailureDocstring:
     def test_flags_missing_and_vocabulary_free_docstrings(self, tmp_path):
@@ -418,6 +494,9 @@ class TestRegistry:
         assert registry.get(registry.FAULTS) is None
         assert registry.enabled(registry.NATIVE)
         assert registry.enabled(registry.OVERLAP)
+        # Telemetry is the one OFF-by-default lever.
+        assert registry.get(registry.TELEMETRY) == "0"
+        assert not registry.enabled(registry.TELEMETRY)
 
     def test_environment_overrides(self, monkeypatch):
         monkeypatch.setenv("SKETCHES_TPU_OVERLAP", "0")
@@ -439,6 +518,9 @@ class TestRegistry:
         assert native.NATIVE_ENV == registry.NATIVE.name
         assert kernels.OVERLAP_ENV == registry.OVERLAP.name
         assert faults.FAULTS_ENV == registry.FAULTS.name
+        from sketches_tpu import telemetry
+
+        assert telemetry.TELEMETRY_ENV == registry.TELEMETRY.name
 
     def test_overlap_kill_switch_still_works_via_registry(self, monkeypatch):
         from sketches_tpu import kernels

@@ -1,0 +1,413 @@
+"""Telemetry layer acceptance suite (ISSUE r9).
+
+Proves the contract the observability layer is sold on:
+
+(a) the DISARMED path is genuinely free -- no counters, no histograms,
+    and (the sharp edge) no clock reads on any instrumented seam;
+(b) armed histograms are real DDSketches: snapshot quantiles agree with
+    the recorded durations within the mapping's relative accuracy;
+(c) engine-demotion counters agree with ``resilience.health()`` after a
+    fault-injected ladder walk -- the ledger and the metrics snapshot
+    are one story;
+(d) all three exporter formats parse (JSON snapshot, Prometheus text,
+    Chrome trace);
+(e) concurrent spans from many threads neither crash nor lose events;
+plus the bench regression gate's exit-code contract, including against
+the real checked-in summaries.
+"""
+
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from sketches_tpu import faults, resilience, telemetry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.pb import wire
+from sketches_tpu.resilience import SketchValueError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts disarmed with empty metrics and a clean ledger,
+    and restores the process's arming state (the telemetry-enabled CI
+    job runs this suite with the env switch on)."""
+    was = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    faults.disarm()
+    resilience.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable(was)
+
+
+def _small_sketch(n=8, seed=0):
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    sk = BatchedDDSketch(n, spec=spec)
+    rng = np.random.RandomState(seed)
+    sk.add(rng.lognormal(0, 0.5, (n, 32)).astype(np.float32))
+    return spec, sk
+
+
+# ---------------------------------------------------------------------------
+# (a) Disarmed path: no counters, no clock reads
+# ---------------------------------------------------------------------------
+
+
+class TestDisarmed:
+    def test_off_by_default_unless_env(self, monkeypatch):
+        # The module-level arming read honors the registry default ("0"):
+        # a fresh process without the switch starts disarmed.  (This
+        # process may have been armed by the CI env; the fixture already
+        # disarmed it, so assert the registry semantics instead.)
+        from sketches_tpu.analysis import registry
+
+        monkeypatch.delenv(registry.TELEMETRY.name, raising=False)
+        assert not registry.enabled(registry.TELEMETRY)
+
+    def test_disarmed_seams_read_no_clock_and_record_nothing(
+        self, monkeypatch, tmp_path
+    ):
+        """Drive every instrumented seam with telemetry OFF while the
+        telemetry clock is booby-trapped: one clock read anywhere on a
+        disarmed dispatch fails the test."""
+
+        def boom():  # pragma: no cover - firing IS the failure
+            raise AssertionError("clock read on the disarmed path")
+
+        monkeypatch.setattr(telemetry, "clock", boom)
+        spec, sk = _small_sketch()
+        sk.get_quantile_values([0.5, 0.99])       # query dispatch
+        other = BatchedDDSketch(8, spec=spec)
+        other.add(np.ones((8, 4), np.float32))
+        sk.merge(other)                           # merge dispatch
+        blobs = wire.state_to_bytes(spec, sk.state)   # wire encode
+        wire.bytes_to_state(spec, blobs)              # wire decode
+        from sketches_tpu import checkpoint
+
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save_state(path, spec, sk.state)   # checkpoint write
+        checkpoint.restore_state(path)                # checkpoint restore
+        from sketches_tpu.ddsketch import JaxDDSketch
+
+        jsk = JaxDDSketch(0.02)
+        jsk.add_many(np.linspace(1.0, 2.0, 64))       # scalar bulk ingest
+        jsk.add(1.0)
+        _ = jsk.count                                 # scalar flush
+        snap = telemetry.snapshot()
+        assert snap["counters"] == {}
+        assert snap["histograms"] == {}
+        assert snap["spans"]["n_events"] == 0
+
+    def test_disarmed_recording_apis_are_noops(self):
+        telemetry.counter_inc("batched.ingest_batches")
+        telemetry.observe("query_s", 0.5, tier="xla")
+        with telemetry.span("query_s"):
+            pass
+        telemetry.event("resilience.downgrade")
+        snap = telemetry.snapshot()
+        assert snap["counters"] == {} and snap["histograms"] == {}
+
+
+# ---------------------------------------------------------------------------
+# (b) Armed histograms: the DDSketch accuracy contract, applied to ourselves
+# ---------------------------------------------------------------------------
+
+
+class TestSelfSketchAccuracy:
+    def test_quantiles_within_mapping_alpha(self):
+        telemetry.enable()
+        rng = np.random.RandomState(7)
+        durs = np.sort(rng.lognormal(-6.0, 1.0, 5001))
+        for d in durs:
+            telemetry.observe("query_s", float(d), tier="test")
+        h = telemetry.snapshot()["histograms"]['query_s{tier="test"}']
+        assert h["count"] == durs.size
+        assert h["min"] == pytest.approx(durs[0])
+        assert h["max"] == pytest.approx(durs[-1])
+        alpha = telemetry.HISTOGRAM_REL_ACC
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                       (0.999, "p999")):
+            exact = durs[int(q * (durs.size - 1))]
+            assert abs(h[key] - exact) <= 1.01 * alpha * exact, (q, h[key], exact)
+
+    def test_instrumented_seams_feed_labeled_histograms(self):
+        telemetry.enable()
+        spec, sk = _small_sketch()
+        sk.get_quantile_values([0.5, 0.99])
+        blobs = wire.state_to_bytes(spec, sk.state)
+        wire.bytes_to_state(spec, blobs)
+        snap = telemetry.snapshot()
+        hist_names = {k.split("{")[0] for k in snap["histograms"]}
+        assert {"ingest_s", "query_s", "wire.encode_s",
+                "wire.decode_s"} <= hist_names
+        # The query histogram is labeled by the RESOLVED engine tier.
+        q_keys = [k for k in snap["histograms"] if k.startswith("query_s")]
+        assert any("tier=" in k and "component=" in k for k in q_keys)
+        assert snap["counters"]["batched.ingest_batches"] == 1.0
+        assert snap["counters"]["wire.blobs_encoded"] == 8.0
+        assert snap["counters"]["wire.blobs_decoded"] == 8.0
+
+    def test_undeclared_and_miskinded_names_refused(self):
+        telemetry.enable()
+        with pytest.raises(SketchValueError):
+            telemetry.counter_inc("no.such.metric")
+        with pytest.raises(SketchValueError):
+            telemetry.observe("batched.ingest_batches", 1.0)  # a counter
+        with pytest.raises(SketchValueError):
+            telemetry.declare("bad.kind", "speedometer", "nope")
+        # Identical re-declaration is a no-op; conflicting kind raises.
+        telemetry.declare("t.user_s", "histogram", "test metric")
+        telemetry.declare("t.user_s", "histogram", "test metric")
+        with pytest.raises(SketchValueError):
+            telemetry.declare("t.user_s", "counter", "flip")
+
+
+# ---------------------------------------------------------------------------
+# (c) Demotion counters match resilience.health()
+# ---------------------------------------------------------------------------
+
+
+class TestResilienceBridge:
+    def test_ladder_walk_counters_match_health(self):
+        telemetry.enable()
+        spec, sk = _small_sketch()
+        sk.get_quantile_values([0.5])  # warm the pre-fault tier choice
+        # One injected lowering failure demotes exactly one rung (on the
+        # CPU suite that is wxla -> xla; the retry then answers).
+        with faults.active({"pallas.lowering": {"times": 1}}):
+            out = np.asarray(sk.get_quantile_values([0.5]))
+        assert np.isfinite(out).all()
+        h = resilience.health()
+        assert h["counters"]["downgrades"] >= 1
+        snap = telemetry.snapshot()
+        walked = sum(
+            v for k, v in snap["counters"].items()
+            if k.startswith("resilience.downgrade")
+        )
+        # Every ledger downgrade taken while armed has a counter twin...
+        assert walked == h["counters"]["downgrades"] == len(h["downgrades"])
+        # ...and the snapshot embeds the ledger itself, so one artifact
+        # can never tell two stories.
+        assert snap["resilience"]["counters"] == h["counters"]
+        assert snap["resilience"]["tiers"] == h["tiers"]
+
+    def test_quarantine_counters_flow_to_snapshot(self):
+        telemetry.enable()
+        spec, sk = _small_sketch(n=64)
+        blobs = wire.state_to_bytes(spec, sk.state)
+        bad, corrupted = faults.corrupt_blobs(blobs, 0.1, seed=3)
+        assert corrupted
+        _, report = wire.bytes_to_state(spec, bad, errors="quarantine")
+        snap = telemetry.snapshot()
+        assert snap["counters"]["wire.blobs_quarantined"] == len(corrupted)
+        assert snap["resilience"]["counters"]["wire.quarantined"] == len(
+            corrupted
+        )
+
+
+# ---------------------------------------------------------------------------
+# (d) Exporters
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+(\n|$)"
+)
+
+
+class TestExporters:
+    def _populate(self):
+        telemetry.enable()
+        spec, sk = _small_sketch()
+        sk.get_quantile_values([0.5, 0.99])
+        resilience.record_downgrade("t.comp", "fast", "slow", "test")
+        telemetry.gauge_set("checkpoint.bytes", 1234.0)
+
+    def test_json_snapshot_round_trips(self):
+        self._populate()
+        snap = telemetry.snapshot()
+        back = json.loads(json.dumps(snap))
+        assert back["counters"] == snap["counters"]
+        assert back["resilience"]["tiers"] == {"t.comp": "slow"}
+
+    def test_prometheus_text_parses(self):
+        self._populate()
+        text = telemetry.prometheus_text()
+        assert text  # non-empty exposition
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert _PROM_LINE.match(line), line
+        assert "sketches_tpu_query_seconds" in text
+        assert 'quantile="0.99"' in text
+        assert "sketches_tpu_resilience_downgrade_total" in text
+
+    def test_chrome_trace_parses_with_device_track_conventions(self):
+        self._populate()
+        trace = json.loads(json.dumps(telemetry.chrome_trace()))
+        events = trace["traceEvents"]
+        # The same conventions bench.py's parser keys on: process_name
+        # metadata + complete ("X") events with ts/dur on pid/tid tracks.
+        assert any(
+            e.get("name") == "process_name" and e.get("ph") == "M"
+            for e in events
+        )
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert xs
+        for e in xs:
+            assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+        assert any(e.get("ph") == "i" for e in events)  # the downgrade
+
+    def test_reset_clears_metrics_not_arming(self):
+        self._populate()
+        telemetry.reset()
+        snap = telemetry.snapshot()
+        assert snap["counters"] == {} and snap["histograms"] == {}
+        assert telemetry.enabled()
+
+
+# ---------------------------------------------------------------------------
+# (e) Thread-safety smoke
+# ---------------------------------------------------------------------------
+
+
+class TestThreads:
+    def test_concurrent_nested_spans(self):
+        telemetry.enable()
+        telemetry.declare("t.outer_s", "histogram", "outer test span")
+        telemetry.declare("t.inner_s", "histogram", "inner test span")
+        n_threads, n_iters = 8, 50
+        errors = []
+        # All workers alive at once (barrier), so thread idents cannot be
+        # recycled and each worker really is a distinct trace track.
+        barrier = threading.Barrier(n_threads)
+
+        def work(i):
+            try:
+                barrier.wait()
+                for _ in range(n_iters):
+                    with telemetry.span("t.outer_s", worker=i):
+                        with telemetry.span("t.inner_s", worker=i):
+                            pass
+            except Exception as e:  # pragma: no cover - failure capture
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=work, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        snap = telemetry.snapshot()
+        outer = sum(
+            h["count"] for k, h in snap["histograms"].items()
+            if k.startswith("t.outer_s")
+        )
+        inner = sum(
+            h["count"] for k, h in snap["histograms"].items()
+            if k.startswith("t.inner_s")
+        )
+        assert outer == inner == n_threads * n_iters
+        assert snap["spans"]["n_events"] == 2 * n_threads * n_iters
+        # Each thread renders as its own trace track.
+        trace = telemetry.chrome_trace()
+        tids = {e["tid"] for e in trace["traceEvents"] if e.get("ph") == "X"}
+        assert len(tids) == n_threads
+
+
+# ---------------------------------------------------------------------------
+# Bench regression gate
+# ---------------------------------------------------------------------------
+
+
+def _summary(value=2.0e9, query=1.0e-3):
+    return {
+        "value": value,
+        "configs": {
+            "c1_10k_streams": {
+                "ingest_fused_per_s": value,
+                "query_p50_s": query,
+            },
+        },
+    }
+
+
+class TestCheckBench:
+    def _run(self, tmp_path, old, new, extra=()):
+        po, pn = tmp_path / "old.json", tmp_path / "new.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        return telemetry.main(
+            ["--check-bench", str(po), str(pn), *extra]
+        )
+
+    def test_equal_summaries_pass(self, tmp_path):
+        assert self._run(tmp_path, _summary(), _summary()) == 0
+
+    def test_improvement_passes(self, tmp_path):
+        assert self._run(
+            tmp_path, _summary(), _summary(value=3.0e9, query=5e-4)
+        ) == 0
+
+    def test_throughput_regression_fails(self, tmp_path):
+        assert self._run(tmp_path, _summary(), _summary(value=1.0e9)) == 1
+
+    def test_latency_regression_fails(self, tmp_path):
+        assert self._run(tmp_path, _summary(), _summary(query=5e-3)) == 1
+
+    def test_within_tolerance_passes(self, tmp_path):
+        # 10% throughput dip sits inside the 15% per-metric budget.
+        assert self._run(tmp_path, _summary(), _summary(value=1.8e9)) == 0
+
+    def test_tolerance_override(self, tmp_path):
+        assert self._run(
+            tmp_path, _summary(), _summary(value=1.8e9),
+            extra=["--tolerance", "0.05"],
+        ) == 1
+
+    def test_incomparable_documents_fail_loudly(self, tmp_path):
+        assert self._run(tmp_path, {"zzz": 1}, {"zzz": 2}) == 2
+
+    def test_checked_in_summaries_pass_the_gate(self):
+        """The CI wiring: the r04 -> r05 checked-in bench documents must
+        clear the per-metric thresholds (this IS the gate CI runs)."""
+        old = os.path.join(REPO_ROOT, "BENCH_local_r04.json")
+        new = os.path.join(REPO_ROOT, "BENCH_local_r05.json")
+        if not (os.path.exists(old) and os.path.exists(new)):
+            pytest.skip("checked-in bench documents not present")
+        assert telemetry.main(["--check-bench", old, new]) == 0
+
+    def test_synthetically_regressed_r05_fails(self, tmp_path):
+        """Acceptance criterion: --check-bench exits non-zero on a
+        synthetically regressed copy of the real summary."""
+        new = os.path.join(REPO_ROOT, "BENCH_local_r05.json")
+        if not os.path.exists(new):
+            pytest.skip("checked-in bench document not present")
+        with open(new) as f:
+            doc = json.load(f)
+        doc["value"] *= 0.5
+        doc["configs"]["c1_10k_streams"]["ingest_fused_per_s"] *= 0.5
+        bad = tmp_path / "regressed.json"
+        bad.write_text(json.dumps(doc))
+        assert telemetry.main(["--check-bench", new, str(bad)]) == 1
+
+    def test_snapshot_dump_flags(self, tmp_path):
+        telemetry.enable()
+        telemetry.counter_inc("batched.ingest_batches")
+        sp = tmp_path / "snap.json"
+        pp = tmp_path / "metrics.prom"
+        assert telemetry.main(
+            ["--snapshot", str(sp), "--prometheus", str(pp)]
+        ) == 0
+        assert json.loads(sp.read_text())["counters"]
+        assert "sketches_tpu_batched_ingest_batches_total" in pp.read_text()
