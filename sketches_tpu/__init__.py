@@ -14,7 +14,7 @@ Two execution tiers:
   or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
-from sketches_tpu import faults, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
@@ -23,11 +23,13 @@ from sketches_tpu.ddsketch import (
     LogCollapsingLowestDenseDDSketch,
     UnequalSketchParametersError,
 )
+from sketches_tpu.integrity import IntegrityReport
 from sketches_tpu.resilience import (
     BlobTooLarge,
     CheckpointCorrupt,
     EngineUnavailable,
     InjectedFault,
+    IntegrityError,
     QuarantineReport,
     ShardLossError,
     ShardLossReport,
@@ -52,7 +54,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.8.0"
+__version__ = "0.9.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -79,6 +81,10 @@ __all__ = [
     "faults",
     # Telemetry layer (self-sketching metrics, spans, exporters)
     "telemetry",
+    # Integrity layer (invariant checks, fingerprints, repair)
+    "integrity",
+    "IntegrityError",
+    "IntegrityReport",
     "SketchError",
     "SketchValueError",
     "SpecError",

@@ -31,6 +31,7 @@ __all__ = [
     "OVERLAP",
     "FAULTS",
     "TELEMETRY",
+    "INTEGRITY",
     "REGISTRY",
     "declared",
     "get",
@@ -102,11 +103,24 @@ TELEMETRY = EnvVar(
     ),
 )
 
+#: Integrity-layer arming (``sketches_tpu.integrity``).
+INTEGRITY = EnvVar(
+    name="SKETCHES_TPU_INTEGRITY",
+    default="0",
+    owner="sketches_tpu.integrity",
+    doc=(
+        "Set to 1 to arm the self-verifying integrity layer (invariant"
+        " checks + fingerprints at the guarded seams; violations raise"
+        " IntegrityError) or to quarantine to report instead of raising;"
+        " 0/unset leaves it off -- one bool test per guarded seam."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
 REGISTRY: Dict[str, EnvVar] = {
-    v.name: v for v in (NATIVE, OVERLAP, FAULTS, TELEMETRY)
+    v.name: v for v in (NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY)
 }
 
 

@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sketches_tpu import faults, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
 from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
 from sketches_tpu.resilience import SketchValueError, SpecError
@@ -1407,7 +1407,17 @@ class BatchedDDSketch:
                 "Cannot merge two batched sketches with different specs"
             )
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        # Guarded integrity seam: snapshot operand fingerprints before
+        # the donated merge consumes the buffers, verify the result
+        # against them after (raise/quarantine per the armed mode).
+        _ipre = (
+            integrity.premerge(self.spec, self.state, other.state)
+            if integrity._ACTIVE
+            else None
+        )
         self._stream_op("merge_aligned", self._merge_body, other.state)
+        if _ipre is not None:
+            integrity.postmerge(self.spec, self.state, _ipre, seam="batched.merge")
         if _t0 is not None:
             telemetry.finish_span("merge_s", _t0, component="batched")
         self._invalidate_plans()

@@ -1,0 +1,481 @@
+"""Seeded chaos-soak harness: every injected fault must be detected or
+provably harmless.
+
+``python -m sketches_tpu.chaos --steps N --seed S`` drives a mixed
+ingest / merge / query / checkpoint / wire workload against a small
+sketch fleet (two value-partial batches, the distributed fold's shape)
+with the **integrity layer armed**, while a seeded campaign scheduler
+injects faults from the ``sketches_tpu.faults`` sites:
+
+========================  =================================================
+site                      expected accounting
+========================  =================================================
+``wire.blob``             quarantine decode isolates exactly the corrupted
+                          blobs; valid blobs decode bit-identically
+``checkpoint.write``      torn write -> ``CheckpointCorrupt`` on restore
+                          (previous checkpoint intact); crashed write ->
+                          ``InjectedFault`` raised, previous file intact
+``pallas.lowering``       query answers through the engine ladder with the
+                          demotion recorded, or the floor re-raises
+``mesh.shard``            the live-mask fold accounts the dead partial's
+                          mass exactly (survivors stay an exact sketch)
+``state.bitflip``         the integrity checker / fingerprint lane catches
+                          the corruption -- or the answers are proven
+                          unchanged within the alpha contract (harmless)
+========================  =================================================
+
+Every fault event lands in the verdict JSON as ``detected``,
+``harmless``, or (the failure mode the harness exists to catch)
+``undetected``; any ``undetected`` event -- or any workload-level
+bookkeeping mismatch -- makes the campaign exit non-zero.  The whole
+campaign is seeded (``np.random.default_rng(seed)`` plus the fault
+plans' own seeds): a failing run replays exactly.
+
+Failure modes: the harness itself raises ``SketchValueError`` on
+invalid arguments; a campaign that cannot complete (unexpected
+exception escaping an un-faulted op) records the error in the verdict
+and exits 1 rather than crashing silently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sketches_tpu import faults, integrity, resilience
+from sketches_tpu.resilience import (
+    CheckpointCorrupt,
+    InjectedFault,
+    IntegrityError,
+    SketchError,
+    SketchValueError,
+)
+
+__all__ = ["run_campaign", "main"]
+
+#: Campaign shape: small enough that a 500+-step soak runs in CI
+#: minutes, big enough that every store/seam carries real mass.
+_N_STREAMS = 16
+_N_BINS = 128
+_BATCH = 32
+_REL_ACC = 0.02
+
+#: Per-step fault probability (when a step's op has a compatible site).
+_FAULT_P = 0.25
+
+#: Quantiles the harmless-verification compares.
+_QS = (0.5, 0.9, 0.99)
+
+
+@dataclasses.dataclass
+class _Campaign:
+    """Mutable campaign state: the two value-partials, the bookkeeping
+    the verdict is audited against, and the fault event log."""
+
+    spec: Any
+    partials: List[Any]
+    rng: Any  # a seeded np.random.default_rng(seed) Generator
+    tmpdir: str
+    expected_count: float = 0.0
+    dropped_count: float = 0.0  # mass accounted lost (dead shards)
+    last_good_ckpt: Optional[str] = None
+    last_good_count: float = 0.0
+    events: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+
+def _stack_partials(c: _Campaign):
+    """The two partial states as one stacked [2, N, B] pytree."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs), c.partials[0].state, c.partials[1].state
+    )
+
+
+def _fold(c: _Campaign, live=None):
+    from sketches_tpu.parallel import fold_live_partials
+
+    if live is None:
+        live = np.ones((2,), bool)
+    return fold_live_partials(c.spec, _stack_partials(c), live)
+
+
+def _quantiles(c: _Campaign, state) -> np.ndarray:
+    from sketches_tpu.batched import quantile
+    import jax.numpy as jnp
+
+    return np.asarray(quantile(c.spec, state, jnp.asarray(_QS)))
+
+
+def _total_count(c: _Campaign) -> float:
+    return float(
+        np.asarray(c.partials[0].state.count, np.float64).sum()
+        + np.asarray(c.partials[1].state.count, np.float64).sum()
+    )
+
+
+def _event(c: _Campaign, step: int, site: str, outcome: str, detail: str = ""):
+    c.events.append(
+        {"step": step, "site": site, "outcome": outcome, "detail": detail}
+    )
+
+
+# ---------------------------------------------------------------------------
+# Workload ops (no fault armed)
+# ---------------------------------------------------------------------------
+
+
+def _op_ingest(c: _Campaign, step: int) -> None:
+    vals = c.rng.lognormal(0.0, 0.5, (_N_STREAMS, _BATCH)).astype(np.float32)
+    c.partials[step % 2].add(vals)
+    c.expected_count += _N_STREAMS * _BATCH
+
+
+def _op_query(c: _Campaign, step: int) -> None:
+    folded = _fold(c)
+    q = _quantiles(c, folded)
+    live = q[np.asarray(folded.count) > 0]
+    if live.size and not np.isfinite(live).all():
+        raise SketchError("query returned non-finite quantiles")
+
+
+def _op_merge(c: _Campaign, step: int) -> None:
+    from sketches_tpu.batched import BatchedDDSketch
+
+    other = BatchedDDSketch(_N_STREAMS, spec=c.spec)
+    vals = c.rng.lognormal(0.0, 0.5, (_N_STREAMS, _BATCH)).astype(np.float32)
+    other.add(vals)
+    c.partials[step % 2].merge(other)
+    c.expected_count += _N_STREAMS * _BATCH
+
+
+def _op_checkpoint(c: _Campaign, step: int) -> None:
+    from sketches_tpu import checkpoint
+
+    path = os.path.join(c.tmpdir, "soak.ckpt")
+    folded = _fold(c)
+    checkpoint.save_state(path, c.spec, folded)
+    spec2, state2 = checkpoint.restore_state(path)
+    if abs(
+        float(np.asarray(state2.count, np.float64).sum()) - _total_count(c)
+    ) > 1.0:
+        raise SketchError("checkpoint round trip lost mass")
+    c.last_good_ckpt = path
+    c.last_good_count = _total_count(c)
+
+
+def _op_wire(c: _Campaign, step: int) -> None:
+    from sketches_tpu.pb import wire
+
+    p = c.partials[step % 2]
+    blobs = wire.state_to_bytes(c.spec, p.state)
+    _, report = wire.bytes_to_state(c.spec, blobs, errors="quarantine")
+    if report:
+        raise SketchError(
+            f"clean wire round trip quarantined {report.n_quarantined} blobs"
+        )
+
+
+_OPS = (_op_ingest, _op_query, _op_merge, _op_checkpoint, _op_wire)
+_OP_WEIGHTS = (0.45, 0.2, 0.15, 0.1, 0.1)
+
+
+# ---------------------------------------------------------------------------
+# Fault drivers: arm a site, drive the workload through it, classify
+# ---------------------------------------------------------------------------
+
+
+def _fault_wire_blob(c: _Campaign, step: int) -> str:
+    from sketches_tpu.pb import wire
+
+    p = c.partials[step % 2]
+    blobs = wire.state_to_bytes(c.spec, p.state)
+    with faults.active(
+        {faults.WIRE_BLOB: dict(mode="corrupt", fraction=0.2, seed=step)}
+    ) as plans:
+        _, report = wire.bytes_to_state(c.spec, blobs, errors="quarantine")
+        fired = plans[faults.WIRE_BLOB].fired
+    if fired == 0:
+        return "skipped"
+    return "detected" if report.n_quarantined == fired else "undetected"
+
+
+def _fault_checkpoint(c: _Campaign, step: int) -> str:
+    from sketches_tpu import checkpoint
+
+    path = os.path.join(c.tmpdir, "torn.ckpt")
+    folded = _fold(c)
+    checkpoint.save_state(path, c.spec, folded)  # a good previous file
+    mode = "truncate" if step % 2 else "raise"
+    with faults.active({faults.CHECKPOINT_WRITE: dict(mode=mode, times=1)}):
+        try:
+            checkpoint.save_state(path, c.spec, folded)
+            crashed = False
+        except InjectedFault:
+            crashed = True  # crash before the atomic rename
+    if crashed:
+        # The previous checkpoint must have survived the crash intact.
+        checkpoint.restore_state(path)
+        return "detected"
+    try:
+        checkpoint.restore_state(path)
+    except CheckpointCorrupt:
+        return "detected"
+    return "undetected"
+
+
+def _fault_lowering(c: _Campaign, step: int) -> str:
+    # Query through a FACADE (not the pure quantile function): the
+    # lowering-fault seam lives in the facade's engine-ladder dispatch.
+    p = c.partials[step % 2]
+    before = resilience.health()["counters"].get("downgrades", 0)
+    with faults.active({faults.PALLAS_LOWERING: dict(times=1)}) as plans:
+        try:
+            q = np.asarray(p.get_quantile_values(list(_QS)))
+            if not np.isfinite(q[np.asarray(p.state.count) > 0]).all():
+                return "undetected"
+        except (InjectedFault, resilience.EngineUnavailable):
+            return "detected"  # the floor re-raised, loudly
+        fired = plans[faults.PALLAS_LOWERING].fired
+    if fired == 0:
+        return "skipped"
+    after = resilience.health()["counters"].get("downgrades", 0)
+    return "detected" if after > before else "undetected"
+
+
+def _fault_mesh_shard(c: _Campaign, step: int) -> str:
+    dead = step % 2
+    live = np.ones((2,), bool)
+    live[dead] = False
+    dead_count = float(
+        np.asarray(c.partials[dead].state.count, np.float64).sum()
+    )
+    survived = _fold(c, live=live)
+    got = float(np.asarray(survived.count, np.float64).sum())
+    if abs(got + dead_count - _total_count(c)) > 1.0:
+        return "undetected"
+    # Account the loss the way merge_partial does, then restore the
+    # partial (simulation: the "dead" shard is still readable).
+    resilience.bump("mesh.dead_shards", 1)
+    return "detected"
+
+
+def _fault_bitflip(c: _Campaign, step: int) -> str:
+    p = c.partials[step % 2]
+    pre_state = p.state  # keep the uncorrupted pytree (flips copy)
+    pre_q = _quantiles(c, _fold(c))
+    fp_pre = integrity.fingerprint(c.spec, pre_state)
+    with faults.active({faults.STATE_BITFLIP: dict(seed=step, times=1)}):
+        flips = faults.state_bitflips(_N_STREAMS, _N_BINS)
+    corrupted = faults.apply_state_bitflips(pre_state, flips)
+    outcome = "undetected"
+    try:
+        report = integrity.verify_state(
+            c.spec, corrupted, seam="chaos.bitflip", errors="quarantine"
+        )
+        if report:
+            outcome = "detected"  # the standalone invariant checker
+        else:
+            # Invariants intact: the cross-boundary fingerprint (the
+            # checkpoint/fold lane's comparison against the pre-flip
+            # reference) is the second detector.
+            try:
+                fp_rep = integrity.verify_restore(
+                    c.spec, corrupted, stored_fp=fp_pre,
+                    seam="chaos.bitflip.fp",
+                )
+                if fp_rep:
+                    outcome = "detected"  # quarantine mode: reported
+                else:
+                    # Both detectors passed: prove the flip harmless --
+                    # the answers are unchanged within the alpha contract.
+                    p.state = corrupted
+                    post_q = _quantiles(c, _fold(c))
+                    same = np.allclose(
+                        post_q, pre_q, rtol=4 * _REL_ACC, atol=1e-6,
+                        equal_nan=True,
+                    )
+                    outcome = "harmless" if same else "undetected"
+            except IntegrityError:
+                outcome = "detected"
+    except IntegrityError:
+        outcome = "detected"
+    finally:
+        # Repair must make the corrupted state consistent again, then
+        # the campaign resumes from the uncorrupted original.
+        fixed, _rep = integrity.repair(c.spec, corrupted)
+        if integrity.check_state(c.spec, fixed):
+            outcome = "undetected"
+        p.state = pre_state
+    return outcome
+
+
+_FAULT_DRIVERS = {
+    faults.WIRE_BLOB: _fault_wire_blob,
+    faults.CHECKPOINT_WRITE: _fault_checkpoint,
+    faults.PALLAS_LOWERING: _fault_lowering,
+    faults.MESH_SHARD: _fault_mesh_shard,
+    faults.STATE_BITFLIP: _fault_bitflip,
+}
+
+
+# ---------------------------------------------------------------------------
+# Campaign
+# ---------------------------------------------------------------------------
+
+
+def run_campaign(
+    steps: int,
+    seed: int,
+    mode: str = "raise",
+    tmpdir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Run a seeded chaos campaign -> the verdict document (JSON-safe).
+
+    Arms the integrity layer (``mode``: ``"raise"`` or ``"quarantine"``)
+    for the duration and restores the prior arming state on exit.  The
+    verdict's ``ok`` is True iff every injected fault was accounted
+    ``detected`` or ``harmless``, the final fold conserves the expected
+    total mass, and no unexpected error escaped an op.  Raises
+    ``SketchValueError`` for non-positive ``steps``; campaign-level
+    failures are *reported*, not raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+
+    was_active, was_mode = integrity.enabled(), integrity.mode()
+    faults.disarm()
+    integrity.arm(mode)
+    own_tmp = None
+    if tmpdir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="sketches_chaos_")
+        tmpdir = own_tmp.name
+    try:
+        spec = SketchSpec(relative_accuracy=_REL_ACC, n_bins=_N_BINS)
+        c = _Campaign(
+            spec=spec,
+            partials=[
+                BatchedDDSketch(_N_STREAMS, spec=spec) for _ in range(2)
+            ],
+            rng=np.random.default_rng(seed),
+            tmpdir=tmpdir,
+        )
+        sites = tuple(_FAULT_DRIVERS)
+        for step in range(steps):
+            op = c.rng.choice(len(_OPS), p=_OP_WEIGHTS)
+            try:
+                _OPS[op](c, step)
+            except Exception as e:  # un-faulted op must not fail
+                c.errors.append(f"step {step} op {_OPS[op].__name__}: {e!r}")
+                break
+            if c.rng.random() < _FAULT_P:
+                site = sites[int(c.rng.integers(len(sites)))]
+                try:
+                    outcome = _FAULT_DRIVERS[site](c, step)
+                except Exception as e:
+                    outcome = "undetected"
+                    c.errors.append(f"step {step} site {site}: {e!r}")
+                if outcome != "skipped":
+                    _event(c, step, site, outcome)
+        # Final audit: the fold conserves every ingested value.
+        final = float(np.asarray(_fold(c).count, np.float64).sum())
+        conserved = abs(final - c.expected_count) <= max(
+            1.0, 1e-5 * c.expected_count
+        )
+        if not conserved:
+            c.errors.append(
+                f"final mass {final:g} != expected {c.expected_count:g}"
+            )
+        outcomes: Dict[str, int] = {}
+        for ev in c.events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        ok = (
+            conserved
+            and not c.errors
+            and outcomes.get("undetected", 0) == 0
+        )
+        return {
+            "steps": steps,
+            "seed": seed,
+            "mode": mode,
+            "ok": ok,
+            "n_faults": len(c.events),
+            "outcomes": outcomes,
+            "events": c.events,
+            "errors": c.errors,
+            "expected_count": c.expected_count,
+            "final_count": final,
+            "integrity_reports": len(integrity.reports()),
+            "health": resilience.health(),
+        }
+    finally:
+        faults.disarm()
+        if was_active:
+            integrity.arm(was_mode)
+        else:
+            integrity.disarm()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry point: run the campaign, write the verdict, exit 0 iff
+    every injected fault was accounted for (1 otherwise).
+
+    ``--platform`` pins the JAX platform via ``jax.config`` (default
+    ``cpu`` -- the soak is a CPU-sized drill; pass ``""`` to keep the
+    environment's backend).  Unexpected campaign errors land in the
+    verdict's ``errors`` list and fail the run rather than crashing.
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m sketches_tpu.chaos",
+        description="seeded chaos-soak campaign: inject faults with the"
+        " integrity layer armed; every fault must be detected or harmless",
+    )
+    parser.add_argument("--steps", type=int, default=500)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--mode", choices=("raise", "quarantine"), default="raise",
+        help="armed integrity behavior during the soak",
+    )
+    parser.add_argument(
+        "--out", default=None, metavar="PATH",
+        help="write the verdict JSON here (stdout always gets a summary)",
+    )
+    parser.add_argument("--platform", default="cpu")
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    verdict = run_campaign(args.steps, args.seed, mode=args.mode)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(verdict, f, indent=1, sort_keys=True)
+            f.write("\n")
+    print(
+        f"chaos: {verdict['steps']} steps, seed {verdict['seed']},"
+        f" {verdict['n_faults']} faults injected, outcomes"
+        f" {verdict['outcomes']}, ok={verdict['ok']}"
+    )
+    for err in verdict["errors"]:
+        print(f"chaos error: {err}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

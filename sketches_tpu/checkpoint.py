@@ -37,7 +37,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sketches_tpu import faults, telemetry
+from sketches_tpu import faults, integrity, telemetry
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.resilience import CheckpointCorrupt
 
@@ -63,6 +63,10 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
     """Write spec + state to ``path`` (npz; compressed, checksummed,
     atomically renamed into place)."""
     _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    if integrity._ACTIVE:
+        # Guarded seam: refuse to persist an already-corrupted state
+        # (raise/quarantine per the armed mode).
+        integrity.verify_state(spec, state, seam="checkpoint.save")
     arrays = {name: np.asarray(jax.device_get(getattr(state, name)))
               for name in _FIELDS}
     spec_json = json.dumps(
@@ -80,11 +84,21 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
     # tmp+rename below closes.  (Write through a file object: np.savez on
     # a bare path silently appends '.npz', which would break the
     # save()/restore() round-trip for any other suffix.)
+    extra = {}
+    if integrity._ACTIVE:
+        # Per-stream content fingerprint rides along so an armed restore
+        # can verify the state across the save->restore boundary even on
+        # pre-checksum readers (sha256 covers bytes; this covers content).
+        extra["__fingerprint__"] = integrity._fingerprint_arrays(
+            arrays["bins_pos"], arrays["bins_neg"], arrays["zero_count"],
+            arrays["key_offset"],
+        )
     buf = io.BytesIO()
     np.savez_compressed(
         buf,
         __spec__=np.frombuffer(spec_json.encode(), np.uint8),
         __checksum__=np.frombuffer(_digest(spec_json, arrays).encode(), np.uint8),
+        **extra,
         **arrays,
     )
     data = buf.getvalue()
@@ -117,7 +131,7 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
     """
     _t0 = telemetry.clock() if telemetry._ACTIVE else None
     try:
-        out = _restore_state_inner(path)
+        spec, state, stored_fp = _restore_state_inner(path)
     except (FileNotFoundError, CheckpointCorrupt):
         raise
     except Exception as e:
@@ -125,13 +139,27 @@ def restore_state(path: str) -> Tuple[SketchSpec, SketchState]:
             f"checkpoint {path!r} failed to restore"
             f" ({type(e).__name__}: {e})"
         ) from e
+    if integrity._ACTIVE:
+        # Guarded seam: invariant-check the restored state, and when the
+        # archive carries a content fingerprint (armed save), verify it
+        # across the save->restore boundary (IntegrityError/quarantine
+        # per the armed mode; distinct from CheckpointCorrupt, which
+        # covers the archive's own validation above).
+        integrity.verify_restore(
+            spec, state, stored_fp, seam="checkpoint.restore"
+        )
     if _t0 is not None:
         telemetry.finish_span("checkpoint.restore_s", _t0)
-    return out
+    return spec, state
 
 
-def _restore_state_inner(path: str) -> Tuple[SketchSpec, SketchState]:
+def _restore_state_inner(path: str):
     with np.load(path) as data:
+        stored_fp = (
+            np.asarray(data["__fingerprint__"])
+            if "__fingerprint__" in data.files
+            else None
+        )
         meta_json = bytes(data["__spec__"]).decode()
         meta = json.loads(meta_json)
         if "__checksum__" in data.files:
@@ -191,7 +219,7 @@ def _restore_state_inner(path: str) -> Tuple[SketchSpec, SketchState]:
                 tile_sums_np(bp, bn).astype(bp.dtype)
             )
         state = SketchState(**arrays)
-    return spec, state
+    return spec, state, stored_fp
 
 
 def save(path: str, sketch: Union[BatchedDDSketch, "DistributedDDSketch"]) -> None:  # noqa: F821

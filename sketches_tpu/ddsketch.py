@@ -27,7 +27,7 @@ import typing
 
 import numpy as np
 
-from sketches_tpu import telemetry
+from sketches_tpu import integrity, telemetry
 from sketches_tpu.mapping import KeyMapping, LogarithmicMapping, zero_threshold
 from sketches_tpu.resilience import (
     SketchValueError,
@@ -182,6 +182,11 @@ class BaseDDSketch:
         flush = getattr(sketch, "_flush", None)
         if flush is not None:
             flush()
+        if integrity._ACTIVE:
+            # Guarded seam: a corrupted operand must be caught BEFORE it
+            # is averaged into self (raises IntegrityError / records a
+            # report per the armed mode).
+            integrity.verify(sketch, seam="host.merge.operand")
         if sketch._count == 0:
             return
 
@@ -200,6 +205,8 @@ class BaseDDSketch:
             self._min = sketch._min
         if sketch._max > self._max:
             self._max = sketch._max
+        if integrity._ACTIVE:
+            integrity.verify(self, seam="host.merge")
 
     def mergeable(self, other: "BaseDDSketch") -> bool:
         """Two sketches are mergeable iff their mappings are identical.
@@ -606,7 +613,16 @@ class JaxDDSketch(BaseDDSketch):
             from sketches_tpu.batched import from_host_sketches
 
             other_state = from_host_sketches(self._spec, [sketch])
+        _ipre = (
+            integrity.premerge(self._spec, self._state, other_state)
+            if integrity._ACTIVE
+            else None
+        )
         self._state = self._merge_fn(self._state, other_state)
+        if _ipre is not None:
+            # Guarded seam: fingerprint/conservation check of the merged
+            # device state against the operand snapshot.
+            integrity.postmerge(self._spec, self._state, _ipre, seam="jax.merge")
         # The merge populated the device state; a still-pending auto-center
         # on the next flush would recenter away from the merged mass.  The
         # merged-in window is now the established one (merge_aligned keeps

@@ -18,6 +18,10 @@ site                    simulates
 ``mesh.shard``          dead value shard(s) -- consumed by
                         ``DistributedDDSketch.merge_partial`` via
                         :func:`dead_shards`
+``state.bitflip``       silent device-state corruption: a bit flipped in a
+                        bin vector -- consumed by the chaos harness via
+                        :func:`state_bitflips` + :func:`apply_state_bitflips`
+                        (the integrity layer's adversary)
 ======================  ====================================================
 
 Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
@@ -53,12 +57,15 @@ __all__ = [
     "WIRE_BLOB",
     "CHECKPOINT_WRITE",
     "MESH_SHARD",
+    "STATE_BITFLIP",
     "SITES",
     "arm",
     "disarm",
     "active",
     "inject",
     "dead_shards",
+    "state_bitflips",
+    "apply_state_bitflips",
     "stats",
     "corrupt_blobs",
 ]
@@ -73,6 +80,7 @@ PALLAS_INGEST = "pallas.ingest"
 WIRE_BLOB = "wire.blob"
 CHECKPOINT_WRITE = "checkpoint.write"
 MESH_SHARD = "mesh.shard"
+STATE_BITFLIP = "state.bitflip"
 
 SITES = (
     NATIVE_LOAD,
@@ -81,6 +89,7 @@ SITES = (
     WIRE_BLOB,
     CHECKPOINT_WRITE,
     MESH_SHARD,
+    STATE_BITFLIP,
 )
 
 #: Fast-path guard: seams check this module flag before calling
@@ -231,6 +240,68 @@ def dead_shards(n_shards: int) -> Tuple[int, ...]:
         plan.fired += 1
         bump("faults." + MESH_SHARD)
     return dead
+
+
+def state_bitflips(n_streams: int, n_bins: int) -> Tuple[Tuple[int, int, int, int], ...]:
+    """Armed device-state bit-flip coordinates -- the ``state.bitflip``
+    site's consumer-side read (it returns data rather than raising, like
+    :func:`dead_shards`).
+
+    Each firing yields one ``(store, stream, bin, bit)`` tuple (store 0
+    = positive bins, 1 = negative bins; bit indexes the 32-bit lane of
+    the bin's dtype), derived deterministically from the plan's seed and
+    its running call count, so a failing sequence replays exactly.
+    Disarmed (the default) it returns ``()`` after one bool test.
+    Respects the plan's ``times`` cap.
+    """
+    if not _ACTIVE:
+        return ()
+    plan = _plans.get(STATE_BITFLIP)
+    if plan is None:
+        return ()
+    plan.calls += 1
+    if plan.times is not None and plan.fired >= plan.times:
+        return ()
+    h = binascii.crc32(f"{plan.seed}:{plan.calls}".encode()) & 0xFFFFFFFF
+    store = h & 1
+    stream = (h >> 1) % max(n_streams, 1)
+    bin_ = (h >> 11) % max(n_bins, 1)
+    bit = (h >> 25) % 32
+    plan.fired += 1
+    bump("faults." + STATE_BITFLIP)
+    return ((store, stream, bin_, bit),)
+
+
+def apply_state_bitflips(state, flips):
+    """Apply :func:`state_bitflips` coordinates to a batched state ->
+    a corrupted COPY (the input pytree is untouched).
+
+    XORs the named bit of the named bin through a 32-bit integer view
+    (f32 and int32 bins both), the chaos harness's model of silent
+    in-memory corruption; the flipped value may be negative, huge, or
+    NaN -- whatever the bit pattern decodes to.  No-op (returns
+    ``state`` unchanged) for an empty flip list.
+    """
+    if not flips:
+        return state
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    arrays = [
+        np.asarray(jax.device_get(a)).copy()
+        for a in (state.bins_pos, state.bins_neg)
+    ]
+    for store, stream, bin_, bit in flips:
+        view = arrays[store].view(np.uint32)
+        view[stream, bin_] ^= np.uint32(1) << np.uint32(bit)
+    return _dc.replace(
+        state,
+        bins_pos=jnp.asarray(arrays[0]),
+        bins_neg=jnp.asarray(arrays[1]),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -13,6 +13,7 @@ everything degrades gracefully to the pure-Python tier when it does not.
 
 from __future__ import annotations
 
+import binascii
 import ctypes
 import math
 import os
@@ -49,6 +50,20 @@ _build_error: typing.Optional[str] = None
 _MAX_LOAD_ATTEMPTS = 3
 _BACKOFF_BASE_S = 0.05
 _BACKOFF_CAP_S = 0.2
+
+
+def _backoff_jitter(key: int, attempt: int) -> float:
+    """Deterministic per-(key, attempt) jitter factor in [0.5, 1.0).
+
+    Co-starting processes that all fail the same load would otherwise
+    retry in lockstep and re-collide on the shared .so/NFS path; scaling
+    each process's capped exponential sleep by a hash of its pid keeps
+    the backoff fully deterministic (no clock, no RNG -- a failing
+    sequence still replays exactly within a process) while de-phasing
+    the fleet.  Never raises; pure function of its arguments.
+    """
+    h = binascii.crc32(f"{key}:{attempt}".encode()) & 0xFFFFFFFF
+    return 0.5 + 0.5 * (h / 2**32)
 
 
 _SRC_PATH = os.path.join(_NATIVE_DIR, "ddsketch_host.cpp")
@@ -94,6 +109,7 @@ def _load() -> typing.Optional[ctypes.CDLL]:
             if attempt:
                 time.sleep(
                     min(_BACKOFF_BASE_S * 2 ** (attempt - 1), _BACKOFF_CAP_S)
+                    * _backoff_jitter(os.getpid(), attempt)
                 )
             try:
                 if faults._ACTIVE:

@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sketches_tpu import faults, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry
 from sketches_tpu.batched import (
     BatchedDDSketch,
     SketchSpec,
@@ -144,7 +144,15 @@ def fold_live_partials(
             )
 
         fn = _LIVE_FOLD_JITS[spec] = jax.jit(body)
-    return fn(partials, jnp.asarray(live, bool))
+    out = fn(partials, jnp.asarray(live, bool))
+    if integrity._ACTIVE:
+        # Parallel checksum lane: per-shard fingerprints of the live
+        # partials must sum to the fold's fingerprint, or a shard was
+        # corrupted in flight (raises/quarantines per the armed mode).
+        integrity.verify_fold(
+            spec, partials, out, live=live, seam="fold_live_partials"
+        )
+    return out
 
 
 def default_mesh(
@@ -626,6 +634,13 @@ class DistributedDDSketch:
             self._merged_cache = self._fold(self.partials)
             if _t0 is not None:
                 telemetry.finish_span("distributed.fold_s", _t0)
+            if integrity._ACTIVE:
+                # Parallel checksum lane over the psum fold: the shard
+                # fingerprints must sum to the folded fingerprint.
+                integrity.verify_fold(
+                    self.spec, self.partials, self._merged_cache,
+                    seam="distributed.fold",
+                )
         return self._merged_cache
 
     def merge_partial(self, live_mask=None):
@@ -888,6 +903,13 @@ class DistributedDDSketch:
         a_st = self.merged_state()
         b_st = other.merged_state()
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        # Guarded integrity seam on the FOLDED states (the partials'
+        # consistency is covered by the fold lane above).
+        _ipre = (
+            integrity.premerge(self.spec, a_st, b_st)
+            if integrity._ACTIVE
+            else None
+        )
         a_binned = (a_st.count - a_st.zero_count) > 0
         target = jnp.where(
             a_binned, a_st.key_offset, b_st.key_offset
@@ -899,6 +921,11 @@ class DistributedDDSketch:
             telemetry.finish_span("merge_s", _t0, component="distributed")
         self._merged_cache = None
         self._invalidate_plans()
+        if _ipre is not None:
+            integrity.postmerge(
+                self.spec, self.merged_state(), _ipre,
+                seam="distributed.merge",
+            )
         # A merge that brings mass populates the batch: a still-pending
         # first-batch auto-center would recenter away from that mass
         # (mirrors BatchedDDSketch.merge).

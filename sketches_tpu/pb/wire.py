@@ -41,7 +41,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from sketches_tpu import faults, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry
 from sketches_tpu.batched import (
     SketchSpec,
     SketchState,
@@ -159,6 +159,14 @@ def state_to_bytes(spec: SketchSpec, state: SketchState) -> List[bytes]:
     import jax
 
     _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    if integrity._ACTIVE:
+        # Guarded seam: refuse to ship a corrupted state onto the wire
+        # (raise/quarantine per the armed mode).  The wire format itself
+        # carries no checksum slot (upstream compatibility), so the
+        # encode-side check is the last armed gate before the bytes
+        # leave the process; ship integrity.fingerprint() out of band to
+        # verify the other end.
+        integrity.verify_state(spec, state, seam="wire.encode")
 
     bins_pos, bins_neg, zero, koff = (
         np.asarray(a)
@@ -694,6 +702,12 @@ def bytes_to_state(
         dec.zero[zi] = zv
         dec.count[zi] += zv
     state = dec.finish()
+    if integrity._ACTIVE:
+        # Guarded seam: invariant-check the decoded batch.  Structurally
+        # valid corruption that forges a *consistent* sketch remains the
+        # wire format's documented limit (no checksum slot); compare an
+        # out-of-band integrity.fingerprint() to close it.
+        integrity.verify_state(spec, state, seam="wire.decode")
     if _t0 is not None:
         telemetry.finish_span("wire.decode_s", _t0, errors=errors)
         telemetry.counter_inc("wire.blobs_decoded", float(len(blobs)))

@@ -53,6 +53,7 @@ __all__ = [
     "WireDecodeError",
     "BlobTooLarge",
     "CheckpointCorrupt",
+    "IntegrityError",
     "EngineUnavailable",
     "ShardLossError",
     "InjectedFault",
@@ -119,6 +120,21 @@ class CheckpointCorrupt(SketchError):
     argument, and must not be swallowed by value-error handlers."""
 
 
+class IntegrityError(SketchError):
+    """Sketch state failed a self-verification: total-mass conservation,
+    bin non-negativity, derived-counter agreement, or a cross-boundary
+    fingerprint mismatch (``sketches_tpu.integrity``).  Like
+    :class:`CheckpointCorrupt`, deliberately NOT a ``ValueError``:
+    corruption is an integrity failure, not a bad argument, and must not
+    be swallowed by value-error handlers.  Carries the
+    :class:`~sketches_tpu.integrity.IntegrityReport` as ``.report`` when
+    raised by :func:`sketches_tpu.integrity.verify`."""
+
+    def __init__(self, message: str, report=None):
+        super().__init__(message)
+        self.report = report
+
+
 class EngineUnavailable(SketchError, RuntimeError):
     """An execution engine cannot be used (native library failed to
     build/load after retries, Pallas tier lost mid-stream).  Subclasses
@@ -155,6 +171,14 @@ _lock = threading.Lock()
 _events: List[DowngradeEvent] = []
 _tiers: Dict[str, str] = {}
 _counters: Dict[str, float] = {}
+_events_dropped = 0
+
+#: Ledger ring bound (mirrors telemetry's 65k span ring): a long-lived
+#: armed process cannot grow the downgrade ledger without bound.  Events
+#: past the cap are dropped (newest first, like the span ring) and
+#: counted in ``health()["downgrades_dropped"]``; the per-component
+#: ``tiers`` map and the counters keep aggregating regardless.
+_MAX_EVENTS = 65536
 
 
 def record_downgrade(
@@ -173,8 +197,12 @@ def record_downgrade(
         component, from_tier, to_tier, str(reason)[:500],
         telemetry.wall_time(),
     )
+    global _events_dropped
     with _lock:
-        _events.append(ev)
+        if len(_events) < _MAX_EVENTS:
+            _events.append(ev)
+        else:
+            _events_dropped += 1
         _tiers[component] = to_tier
         _counters["downgrades"] = _counters.get("downgrades", 0) + 1
     if telemetry._ACTIVE:
@@ -196,24 +224,30 @@ def health() -> dict:
     """Snapshot of the resilience ledger.
 
     Returns ``{"tiers": {component: current tier}, "counters": {...},
-    "downgrades": [event dicts, oldest first]}``.  Empty maps mean no
-    component has degraded -- the healthy steady state.  The snapshot is
-    a deep copy; mutating it does not touch the ledger.
+    "downgrades": [event dicts, oldest first],
+    "downgrades_dropped": n}``.  Empty maps mean no component has
+    degraded -- the healthy steady state.  ``downgrades_dropped`` counts
+    events past the fixed ring bound (oldest 65536 kept); the tiers map
+    and counters aggregate every event regardless.  The snapshot is a
+    deep copy; mutating it does not touch the ledger.
     """
     with _lock:
         return {
             "tiers": dict(_tiers),
             "counters": dict(_counters),
             "downgrades": [dataclasses.asdict(e) for e in _events],
+            "downgrades_dropped": _events_dropped,
         }
 
 
 def reset() -> None:
     """Clear the ledger (test isolation hook)."""
+    global _events_dropped
     with _lock:
         _events.clear()
         _tiers.clear()
         _counters.clear()
+        _events_dropped = 0
 
 
 # ---------------------------------------------------------------------------

@@ -132,6 +132,14 @@ _DECLARED = (
            "Native-engine build/load attempts (retries included)."),
     Metric("resilience.downgrade", "counter", "sketches_tpu.resilience",
            "Downgrade events recorded in the resilience health ledger."),
+    Metric("integrity.checks", "counter", "sketches_tpu.integrity",
+           "Armed integrity verifications run at the guarded seams."),
+    Metric("integrity.violations", "counter", "sketches_tpu.integrity",
+           "Invariant/fingerprint violations the integrity layer caught."),
+    Metric("integrity.repairs", "counter", "sketches_tpu.integrity",
+           "Fields rewritten by integrity.repair() passes."),
+    Metric("integrity.check_s", "histogram", "sketches_tpu.integrity",
+           "Armed integrity verification wall time (label: seam)."),
     Metric("checkpoint.bytes", "gauge", "sketches_tpu.checkpoint",
            "Size of the most recently written checkpoint, in bytes."),
     Metric("ingest_s", "histogram", "sketches_tpu.batched",

@@ -18,6 +18,7 @@ _EXAMPLES = os.path.join(
         "distributed_mesh.py",
         "heterogeneous_fleet.py",
         "wire_interop.py",
+        "chaos_drill.py",
     ],
 )
 def test_example_runs_clean(script):

@@ -1,0 +1,634 @@
+"""Integrity layer acceptance suite (ISSUE r10).
+
+Proves the contract the self-verification layer is sold on:
+
+(a) the invariant checker passes every clean tier (host / Jax / batched
+    / stacked distributed partials) and catches each corruption class
+    (desynced count, negative mass, non-finite values, derived-counter
+    drift, bound violations) -- including injected device-state bit
+    flips;
+(b) fingerprints are recenter-invariant and additive across merge and
+    the psum fold (the parallel checksum lane), and detect content
+    changes across the checkpoint save->restore boundary;
+(c) the guarded seams (merge, fold, checkpoint, wire) raise
+    ``IntegrityError`` in raise mode and report-quarantine in
+    quarantine mode, with the ledger and telemetry counters agreeing;
+(d) ``repair()`` rewrites exactly the derivable fields and the repaired
+    state always verifies clean;
+(e) the DISARMED path is genuinely free: one bool test per guarded
+    seam, no checksum, no device fetch, no clock read (booby-trap
+    proof, telemetry's discipline);
+(f) fault/detector closure: every site ``faults.py`` can inject maps to
+    a detector that catches it (or a proof of harmlessness) -- no
+    silently undetectable fault site exists -- plus the seeded chaos
+    campaign's end-to-end verdict;
+(g) satellites: the bounded resilience ledger ring and the seeded
+    native-backoff jitter.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sketches_tpu import (
+    DDSketch,
+    JaxDDSketch,
+    chaos,
+    checkpoint,
+    faults,
+    integrity,
+    resilience,
+    telemetry,
+)
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.parallel import DistributedDDSketch, fold_live_partials
+from sketches_tpu.pb import wire
+from sketches_tpu.resilience import IntegrityError
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers():
+    """Every test starts with integrity/faults disarmed and clean
+    ledgers, and restores the process arming state (the integrity-armed
+    CI job runs this suite with the env switch on)."""
+    was, was_mode = integrity.enabled(), integrity.mode()
+    tele_was = telemetry.enabled()
+    integrity.disarm()
+    integrity.reset()
+    faults.disarm()
+    resilience.reset()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+    integrity.reset()
+    telemetry.reset()
+    telemetry.enable(tele_was)
+    if was:
+        integrity.arm(was_mode)
+    else:
+        integrity.disarm()
+
+
+SPEC = SketchSpec(relative_accuracy=0.02, n_bins=128)
+
+
+def _batched(n=8, seed=0, spec=SPEC):
+    sk = BatchedDDSketch(n, spec=spec)
+    rng = np.random.RandomState(seed)
+    v = (
+        rng.lognormal(0.0, 0.5, (n, 48))
+        * np.where(rng.rand(n, 48) < 0.25, -1.0, 1.0)
+        * (rng.rand(n, 48) > 0.1)
+    ).astype(np.float32)
+    sk.add(v)
+    return sk
+
+
+# ---------------------------------------------------------------------------
+# (a) Invariant checker
+# ---------------------------------------------------------------------------
+
+
+class TestChecker:
+    def test_clean_tiers_pass(self):
+        # host
+        h = DDSketch(0.02)
+        rng = np.random.RandomState(1)
+        for v in rng.lognormal(0, 0.5, 500):
+            h.add(float(v))
+        h.add(0.0)
+        h.add(-2.5)
+        assert not integrity.check(h)
+        # jax facade
+        j = JaxDDSketch(0.02, n_bins=128)
+        j.add_many(np.linspace(0.25, 4.0, 300))
+        assert not integrity.check(j)
+        # batched
+        sk = _batched()
+        assert not integrity.check(sk)
+        # distributed (stacked partials)
+        d = DistributedDDSketch(8, spec=SPEC)
+        d.add(rng.lognormal(0, 0.4, (8, 16)).astype(np.float32))
+        assert not integrity.check(d)
+        # empty states are the identity steady state, not violations
+        assert not integrity.check(BatchedDDSketch(4, spec=SPEC))
+        assert not integrity.check_host(DDSketch(0.02))
+
+    @pytest.mark.parametrize(
+        "field,mutate,expect",
+        [
+            ("count", lambda a: a * 0 + 7.0, "mass_conservation"),
+            ("bins_pos", lambda a: a.at[0, 3].set(-1.0), "negative_mass"),
+            ("bins_neg", lambda a: a.at[1, 5].set(jnp.nan), "nonfinite"),
+            ("neg_total", lambda a: a + 5.0, "neg_total"),
+            ("tile_sums", lambda a: a + 3.0, "tile_sums"),
+            ("pos_hi", lambda a: a * 0 - 1, "occupied_bounds"),
+            ("sum", lambda a: a * 0 + 1e30, "sum_bound"),
+        ],
+    )
+    def test_each_corruption_class_is_caught(self, field, mutate, expect):
+        sk = _batched()
+        st = dataclasses.replace(
+            sk.state, **{field: mutate(getattr(sk.state, field))}
+        )
+        report = integrity.check_state(SPEC, st)
+        assert report, f"{field} corruption slipped through"
+        assert expect in {v.invariant for v in report.violations}
+
+    def test_empty_identity_violation(self):
+        sk = BatchedDDSketch(4, spec=SPEC)
+        st = dataclasses.replace(sk.state, sum=sk.state.sum + 3.0)
+        report = integrity.check_state(SPEC, st)
+        assert {v.invariant for v in report.violations} == {"empty_identity"}
+
+    def test_host_desync_is_caught(self):
+        h = DDSketch(0.02)
+        for v in (1.0, 2.0, 3.0):
+            h.add(v)
+        h._count += 10.0  # silent desync
+        report = integrity.check_host(h)
+        assert report and report.violations[0].invariant == "mass_conservation"
+
+    def test_stacked_partials_index_per_slice(self):
+        d = DistributedDDSketch(4, spec=SPEC)
+        d.add(np.full((4, 16), 2.0, np.float32))
+        bad = dataclasses.replace(
+            d.partials, count=d.partials.count.at[0, 2].add(99.0)
+        )
+        report = integrity.check_state(SPEC, bad)
+        assert report and report.violations[0].stream == 2
+
+    def test_bitflip_is_caught_or_harmless(self):
+        sk = _batched()
+        caught = harmless = 0
+        for seed in range(24):
+            faults.arm(faults.STATE_BITFLIP, seed=seed, times=1)
+            flips = faults.state_bitflips(8, SPEC.n_bins)
+            faults.disarm()
+            bad = faults.apply_state_bitflips(sk.state, flips)
+            if integrity.check_state(SPEC, bad):
+                caught += 1
+            elif np.allclose(
+                integrity.fingerprint(SPEC, bad),
+                integrity.fingerprint(SPEC, sk.state),
+            ):
+                harmless += 1  # e.g. a -0.0 flip: content unchanged
+            else:
+                # Consistent-but-changed content: the cross-boundary
+                # fingerprint is the detector by design.
+                caught += 1
+        assert caught + harmless == 24 and caught > 0
+
+
+# ---------------------------------------------------------------------------
+# (b) Fingerprints
+# ---------------------------------------------------------------------------
+
+
+class TestFingerprint:
+    def test_additive_under_merge_and_fold(self):
+        a, b = _batched(seed=1), _batched(seed=2)
+        fa = integrity.fingerprint(SPEC, a.state)
+        fb = integrity.fingerprint(SPEC, b.state)
+        m = a.copy()
+        m.merge(b.copy())
+        np.testing.assert_allclose(
+            integrity.fingerprint(SPEC, m.state), fa + fb,
+            rtol=1e-5, atol=1e-3,
+        )
+        # psum-fold lane: stacked partials' fingerprints sum to the fold's
+        d = DistributedDDSketch(8, spec=SPEC)
+        d.add(np.random.RandomState(3).lognormal(0, 0.5, (8, 16)).astype(np.float32))
+        fp_shards = integrity.fingerprint(SPEC, d.partials)
+        assert fp_shards.ndim == 2
+        np.testing.assert_allclose(
+            integrity.fingerprint(SPEC, d.merged_state()),
+            fp_shards.sum(0), rtol=1e-5, atol=1e-3,
+        )
+
+    def test_recenter_invariant(self):
+        sk = _batched(seed=4)
+        fp0 = integrity.fingerprint(SPEC, sk.state)
+        sk.recenter(np.asarray(sk.state.key_offset) + 5)  # mass stays inside
+        np.testing.assert_allclose(
+            integrity.fingerprint(SPEC, sk.state), fp0, rtol=1e-6, atol=1e-6
+        )
+
+    def test_host_and_device_fingerprints_agree(self):
+        from sketches_tpu.batched import to_host_sketches
+
+        sk = _batched(seed=5)
+        hosts = to_host_sketches(SPEC, sk.state)
+        fp_dev = integrity.fingerprint(SPEC, sk.state)
+        fp_host = np.asarray([integrity.fingerprint_host(h) for h in hosts])
+        np.testing.assert_allclose(fp_dev, fp_host, rtol=1e-5, atol=1e-3)
+
+    def test_detects_content_change(self):
+        sk = _batched(seed=6)
+        fp0 = integrity.fingerprint(SPEC, sk.state)
+        bad = dataclasses.replace(
+            sk.state, bins_pos=sk.state.bins_pos.at[2, 40].add(1.0)
+        )
+        assert not np.allclose(integrity.fingerprint(SPEC, bad), fp0)
+
+
+# ---------------------------------------------------------------------------
+# (c) Guarded seams, both modes
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_count(state):
+    return dataclasses.replace(state, count=state.count + 50.0)
+
+
+class TestSeams:
+    def test_batched_merge_catches_corrupt_operand(self):
+        integrity.arm("raise")
+        sk, other = _batched(seed=7), _batched(seed=8)
+        other._state = _corrupt_count(other.state)
+        with pytest.raises(IntegrityError) as ei:
+            sk.merge(other)
+        assert ei.value.report is not None
+        assert resilience.health()["counters"]["integrity.violations"] > 0
+
+    def test_host_merge_catches_corrupt_operand(self):
+        integrity.arm("raise")
+        a, b = DDSketch(0.02), DDSketch(0.02)
+        for v in (1.0, 2.0):
+            a.add(v)
+            b.add(v)
+        b._count += 9.0
+        with pytest.raises(IntegrityError):
+            a.merge(b)
+
+    def test_jax_merge_seam_clean(self):
+        integrity.arm("raise")
+        a = JaxDDSketch(0.02, n_bins=128)
+        a.add_many(np.linspace(0.5, 2.0, 100))
+        b = JaxDDSketch(0.02, n_bins=128)
+        b.add_many(np.linspace(1.0, 4.0, 100))
+        a.merge(b)  # no raise: clean merge passes the fingerprint lane
+        assert a.count == 200.0
+
+    def test_fold_lane_catches_corrupt_partial(self):
+        integrity.arm("raise")
+        d = DistributedDDSketch(8, spec=SPEC)
+        d.add(np.full((8, 16), 1.5, np.float32))
+        bad = dataclasses.replace(
+            d.partials, count=d.partials.count.at[0, 1].add(17.0)
+        )
+        with pytest.raises(IntegrityError):
+            fold_live_partials(SPEC, bad, np.ones((d.n_value_shards,), bool))
+
+    def test_checkpoint_roundtrip_and_fp_mismatch(self, tmp_path):
+        integrity.arm("raise")
+        sk = _batched(seed=9)
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save_state(path, SPEC, sk.state)
+        spec2, state2 = checkpoint.restore_state(path)  # clean: no raise
+        np.testing.assert_array_equal(
+            np.asarray(state2.count), np.asarray(sk.state.count)
+        )
+        # A stored fingerprint that does not match the state is caught.
+        with pytest.raises(IntegrityError):
+            integrity.verify_restore(
+                SPEC, state2,
+                stored_fp=integrity.fingerprint(SPEC, state2) + 1.0,
+            )
+        # ...and refuses to persist a corrupted state at all.
+        with pytest.raises(IntegrityError):
+            checkpoint.save_state(path, SPEC, _corrupt_count(sk.state))
+
+    def test_wire_seams(self):
+        integrity.arm("raise")
+        sk = _batched(seed=10)
+        blobs = wire.state_to_bytes(SPEC, sk.state)  # clean encode passes
+        wire.bytes_to_state(SPEC, blobs)  # clean decode passes
+        with pytest.raises(IntegrityError):
+            wire.state_to_bytes(SPEC, _corrupt_count(sk.state))
+
+    def test_quarantine_mode_reports_instead_of_raising(self):
+        integrity.arm("quarantine")
+        telemetry.enable()
+        sk, other = _batched(seed=11), _batched(seed=12)
+        other._state = _corrupt_count(other.state)
+        sk.merge(other)  # no raise
+        reps = integrity.reports()
+        assert reps and any(r.n_violations for r in reps)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("integrity.violations", 0) > 0
+        assert counters.get("integrity.checks", 0) > 0
+        assert (
+            resilience.health()["counters"]["integrity.violations"]
+            >= reps[0].n_violations
+        )
+
+    def test_armed_seams_change_no_answers(self):
+        """The whole clean workflow runs identically with integrity
+        armed: same counts, same quantiles, no exception."""
+        qs = [0.5, 0.9, 0.99]
+        ref_a, ref_b = _batched(seed=13), _batched(seed=14)
+        ref_a.merge(ref_b)
+        ref_q = np.asarray(ref_a.get_quantile_values(qs))
+        integrity.arm("raise")
+        a, b = _batched(seed=13), _batched(seed=14)
+        a.merge(b)
+        np.testing.assert_array_equal(
+            np.asarray(a.get_quantile_values(qs)), ref_q
+        )
+
+
+# ---------------------------------------------------------------------------
+# (d) Repair
+# ---------------------------------------------------------------------------
+
+
+class TestRepair:
+    def test_repairs_derivable_fields(self):
+        sk = _batched(seed=15)
+        bad = dataclasses.replace(
+            sk.state,
+            count=sk.state.count + 40.0,
+            neg_total=sk.state.neg_total + 2.0,
+            tile_sums=sk.state.tile_sums * 0,
+            bins_pos=sk.state.bins_pos.at[0, 0].set(-3.0),
+        )
+        assert integrity.check_state(SPEC, bad)
+        fixed, repairs = integrity.repair(SPEC, bad)
+        assert repairs.n_violations >= 3
+        kinds = {v.invariant for v in repairs.violations}
+        assert {"count", "neg_total", "tile_sums", "bins_pos"} <= kinds
+        assert not integrity.check_state(SPEC, fixed)
+
+    def test_repair_restores_empty_identities(self):
+        sk = BatchedDDSketch(4, spec=SPEC)
+        bad = dataclasses.replace(sk.state, sum=sk.state.sum + 5.0)
+        fixed, repairs = integrity.repair(SPEC, bad)
+        assert repairs
+        assert not integrity.check_state(SPEC, fixed)
+        assert float(np.asarray(fixed.sum).sum()) == 0.0
+
+    def test_repair_noop_on_clean_state(self):
+        sk = _batched(seed=16)
+        fixed, repairs = integrity.repair(SPEC, sk.state)
+        assert not repairs
+        for f in dataclasses.fields(type(fixed)):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fixed, f.name)),
+                np.asarray(getattr(sk.state, f.name)), f.name,
+            )
+
+
+# ---------------------------------------------------------------------------
+# (e) Disarmed path: one bool test, nothing else
+# ---------------------------------------------------------------------------
+
+
+class TestDisarmed:
+    def test_off_by_default_unless_env(self, monkeypatch):
+        from sketches_tpu.analysis import registry
+
+        monkeypatch.delenv(registry.INTEGRITY.name, raising=False)
+        assert registry.get(registry.INTEGRITY) == "0"
+
+    def test_disarmed_seams_do_no_integrity_work(self, monkeypatch, tmp_path):
+        """Booby-trap every integrity entry point the guarded seams call;
+        one call anywhere on a disarmed dispatch fails the test."""
+
+        def boom(*a, **k):  # pragma: no cover - firing IS the failure
+            raise AssertionError("integrity work on the disarmed path")
+
+        for name in ("check", "check_state", "check_host", "verify",
+                     "verify_state", "verify_fold", "verify_restore",
+                     "premerge", "postmerge", "fingerprint",
+                     "_fingerprint_arrays"):
+            monkeypatch.setattr(integrity, name, boom)
+        sk, other = _batched(seed=17), _batched(seed=18)
+        sk.merge(other)                                   # batched merge
+        h1, h2 = DDSketch(0.02), DDSketch(0.02)
+        h1.add(1.0)
+        h2.add(2.0)
+        h1.merge(h2)                                      # host merge
+        j1 = JaxDDSketch(0.02, n_bins=128)
+        j1.add_many(np.asarray([1.0, 2.0]))
+        j2 = JaxDDSketch(0.02, n_bins=128)
+        j2.add_many(np.asarray([3.0]))
+        j1.merge(j2)                                      # jax merge
+        d = DistributedDDSketch(8, spec=SPEC)
+        d.add(np.full((8, 16), 1.0, np.float32))
+        d.merged_state()                                  # psum fold
+        fold_live_partials(SPEC, d.partials, np.ones((d.n_value_shards,), bool))
+        blobs = wire.state_to_bytes(SPEC, sk.state)       # wire encode
+        wire.bytes_to_state(SPEC, blobs)                  # wire decode
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save_state(path, SPEC, sk.state)       # checkpoint save
+        checkpoint.restore_state(path)                    # restore
+
+
+# ---------------------------------------------------------------------------
+# (f) Fault/detector closure + the chaos campaign
+# ---------------------------------------------------------------------------
+
+
+def _detect_native_load():
+    from sketches_tpu import native
+
+    faults.arm(faults.NATIVE_LOAD)  # persistent: all attempts fail
+    try:
+        native.reset()
+        assert not native.available()
+    finally:
+        faults.disarm()
+        native.reset()
+    return resilience.health()["tiers"].get("native") == "python"
+
+
+def _detect_pallas_ingest():
+    from sketches_tpu import kernels
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    n = kernels._BN
+    sk = BatchedDDSketch(n, spec=spec, engine="pallas")
+    faults.arm(faults.PALLAS_INGEST, times=1)
+    try:
+        sk.add(np.full((n, kernels._BS), 1.0, np.float32))
+    finally:
+        faults.disarm()
+    return resilience.health()["tiers"].get("batched.ingest") == "xla"
+
+
+def _detect_pallas_lowering():
+    sk = _batched(seed=21)
+    faults.arm(faults.PALLAS_LOWERING, times=1)
+    try:
+        sk.get_quantile_value(0.5)  # demotes a tier, recorded, answers
+    finally:
+        faults.disarm()
+    return resilience.health()["counters"].get("downgrades", 0) > 0
+
+
+def _detect_wire_blob():
+    sk = _batched(seed=22)
+    blobs = wire.state_to_bytes(SPEC, sk.state)
+    with faults.active(
+        {faults.WIRE_BLOB: dict(mode="corrupt", fraction=0.3, seed=9)}
+    ) as plans:
+        _, report = wire.bytes_to_state(SPEC, blobs, errors="quarantine")
+        fired = plans[faults.WIRE_BLOB].fired
+    return fired > 0 and report.n_quarantined == fired
+
+
+def _detect_checkpoint_write():
+    import tempfile
+
+    from sketches_tpu.resilience import CheckpointCorrupt
+
+    sk = _batched(seed=23)
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "t.ckpt")
+        with faults.active(
+            {faults.CHECKPOINT_WRITE: dict(mode="truncate", times=1)}
+        ):
+            checkpoint.save_state(path, SPEC, sk.state)
+        try:
+            checkpoint.restore_state(path)
+        except CheckpointCorrupt:
+            return True
+    return False
+
+
+def _detect_mesh_shard():
+    d = DistributedDDSketch(8, spec=SPEC)
+    d.add(np.full((8, 16), 1.5, np.float32))
+    faults.arm(faults.MESH_SHARD, shards=(0,))
+    try:
+        survived, report = d.merge_partial()
+    finally:
+        faults.disarm()
+    return (
+        report.n_dead == 1
+        and resilience.health()["counters"].get("mesh.dead_shards", 0) >= 1
+    )
+
+
+def _detect_state_bitflip():
+    """Sampled closure over many flip positions: every flip is either
+    caught by the invariant checker, caught by the fingerprint, or its
+    content is provably unchanged (-0.0)."""
+    sk = _batched(seed=24)
+    fp0 = integrity.fingerprint(SPEC, sk.state)
+    for seed in range(16):
+        faults.arm(faults.STATE_BITFLIP, seed=seed, times=1)
+        flips = faults.state_bitflips(8, SPEC.n_bins)
+        faults.disarm()
+        bad = faults.apply_state_bitflips(sk.state, flips)
+        if integrity.check_state(SPEC, bad):
+            continue
+        if not np.allclose(integrity.fingerprint(SPEC, bad), fp0):
+            continue
+        return np.allclose(  # content unchanged -> harmless, by proof
+            np.asarray(bad.bins_pos, np.float64),
+            np.asarray(sk.state.bins_pos, np.float64),
+        ) and np.allclose(
+            np.asarray(bad.bins_neg, np.float64),
+            np.asarray(sk.state.bins_neg, np.float64),
+        )
+    return True
+
+
+#: Every injectable site maps to a detector proof -- the closure the
+#: satellite task demands: no silently undetectable fault site.
+_SITE_DETECTORS = {
+    faults.NATIVE_LOAD: _detect_native_load,
+    faults.PALLAS_INGEST: _detect_pallas_ingest,
+    faults.PALLAS_LOWERING: _detect_pallas_lowering,
+    faults.WIRE_BLOB: _detect_wire_blob,
+    faults.CHECKPOINT_WRITE: _detect_checkpoint_write,
+    faults.MESH_SHARD: _detect_mesh_shard,
+    faults.STATE_BITFLIP: _detect_state_bitflip,
+}
+
+
+class TestClosure:
+    def test_every_site_has_a_detector(self):
+        """The property the satellite demands: the detector table covers
+        every injectable site, and a new site cannot land without one."""
+        assert set(_SITE_DETECTORS) == set(faults.SITES)
+
+    @pytest.mark.parametrize("site", faults.SITES)
+    def test_site_is_detected(self, site):
+        assert _SITE_DETECTORS[site](), f"{site} went undetected"
+
+    def test_chaos_campaign_verdict(self):
+        verdict = chaos.run_campaign(80, seed=3)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["n_faults"] > 0
+        assert verdict["outcomes"].get("undetected", 0) == 0
+        # Deterministic: the same seed replays the same campaign.
+        again = chaos.run_campaign(80, seed=3)
+        assert again["events"] == verdict["events"]
+
+    def test_chaos_cli_exit_code(self, tmp_path):
+        out = str(tmp_path / "verdict.json")
+        rc = chaos.main(["--steps", "40", "--seed", "5", "--out", out,
+                         "--platform", ""])
+        assert rc == 0
+        import json
+
+        with open(out) as f:
+            verdict = json.load(f)
+        assert verdict["ok"] and verdict["steps"] == 40
+
+
+# ---------------------------------------------------------------------------
+# (g) Satellites: ledger ring + native backoff jitter
+# ---------------------------------------------------------------------------
+
+
+class TestSatellites:
+    def test_health_ledger_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setattr(resilience, "_MAX_EVENTS", 16)
+        for i in range(40):
+            resilience.record_downgrade("comp", "a", "b", f"r{i}")
+        h = resilience.health()
+        assert len(h["downgrades"]) == 16
+        assert h["downgrades_dropped"] == 24
+        assert h["counters"]["downgrades"] == 40  # counters keep the truth
+        assert h["tiers"]["comp"] == "b"
+        resilience.reset()
+        assert resilience.health()["downgrades_dropped"] == 0
+
+    def test_native_backoff_jitter_deterministic_and_bounded(self):
+        from sketches_tpu.native import _backoff_jitter
+
+        seen = set()
+        for pid in (100, 101, 102, 7777):
+            for attempt in (1, 2):
+                j = _backoff_jitter(pid, attempt)
+                assert 0.5 <= j < 1.0
+                assert j == _backoff_jitter(pid, attempt)  # deterministic
+                seen.add(round(j, 6))
+        assert len(seen) > 4  # co-starting pids de-phase
+
+    def test_repair_counts_into_telemetry(self):
+        telemetry.enable()
+        integrity.arm("quarantine")
+        sk = _batched(seed=19)
+        bad = dataclasses.replace(sk.state, count=sk.state.count + 30.0)
+        _, repairs = integrity.repair(SPEC, bad)
+        assert repairs
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("integrity.repairs", 0) >= repairs.n_violations
+
+    def test_integrity_env_registered(self):
+        from sketches_tpu.analysis import registry
+
+        var = registry.lookup(integrity.INTEGRITY_ENV)
+        assert var.owner == "sketches_tpu.integrity"
+        assert var.default == "0"
