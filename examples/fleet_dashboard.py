@@ -1,0 +1,209 @@
+"""Fleet observability end to end: N shard processes, one merged story.
+
+Scenario: a sketch-serving fleet runs many processes (hosts, shards,
+jobs).  Each process self-sketches its own runtime with the telemetry
+layer (PR 4) -- but a fleet dashboard needs ONE p99, not N of them.
+This example exercises the whole r11 observability stack:
+
+1. **Shards**: N worker processes each run a production-shaped workload
+   (batched ingest, fused quantile queries, a merge, a wire round trip)
+   with telemetry + device-time profiling + the accuracy shadow audit
+   armed, then write their snapshot JSON -- the per-process artifact.
+2. **Merge**: the parent folds the shard snapshots with
+   ``telemetry.merge_snapshots``: counters sum, histograms merge as
+   DDSketches, so the fleet-wide p50/p99 printed below carry the same
+   alpha=0.01 guarantee as any single process's (the paper's
+   mergeability property, applied to the library's own telemetry).
+3. **Attribution**: the merged device-time table says where the
+   accelerator's time went, per engine tier and phase, against the
+   jaxpr-derived roofline estimate.
+4. **SLO gate**: ``telemetry.check_slo`` evaluates the declared SLO
+   inventory against the merged snapshot -- the same gate CI runs via
+   ``python -m sketches_tpu.telemetry --check-slo``.
+
+Run anywhere (CPU by default; pin JAX_PLATFORMS=tpu to use an
+accelerator):
+    python examples/fleet_dashboard.py [--shards 3] [--outdir DIR]
+
+Exit code: 0 when every evaluable SLO is within budget, 1 on a burning
+SLO or a failed shard (the dashboard doubles as a gate).
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SELF_PROVISIONED = "JAX_PLATFORMS" not in os.environ
+if _SELF_PROVISIONED:
+    # Self-provision the CPU platform (the distributed_mesh.py pattern):
+    # with no explicit pin, backend discovery may attach to a remote /
+    # tunneled accelerator and crawl -- an example must degrade to the
+    # portable platform, not hang.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+N_STREAMS = 256
+BATCH = 1024
+N_BATCHES = 8
+QS = [0.5, 0.9, 0.99]
+
+
+def run_shard(shard: int, outdir: str) -> None:
+    """One fleet shard: warm up, arm the observability layers, run the
+    workload, write the snapshot artifact."""
+    import numpy as np
+
+    from sketches_tpu import accuracy, profiling, telemetry
+    from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+    from sketches_tpu.pb import wire
+
+    rng = np.random.RandomState(1000 + shard)
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    sk = BatchedDDSketch(N_STREAMS, spec=spec)
+
+    # Warm up DISARMED: jit compilation is a process-lifetime one-off,
+    # not a serving latency -- the SLO gate measures the warm path.
+    # Two adds: the first compiles the recentering first-batch path,
+    # the second the steady-state ingest the armed loop below takes.
+    # ``other`` (the armed phase's merge operand) warms here too: facade
+    # jits are per-instance, so a facade born inside the armed region
+    # would bill its compile to the ingest SLO.
+    sk.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
+    sk.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
+    sk.get_quantile_values(QS)
+    other = BatchedDDSketch(N_STREAMS, spec=spec)
+    other.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
+    other.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
+    sk.merge(other)
+    wire.bytes_to_state(spec, wire.state_to_bytes(spec, sk.state))
+
+    telemetry.enable()
+    telemetry.reset()
+    profiling.enable()
+    profiling.reset()
+    accuracy.enable()
+    accuracy.reset()
+    accuracy.watch(sk, f"shard{shard}", streams=(0, 1, 2, 3), interval=4)
+
+    for _ in range(N_BATCHES):
+        vals = rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32)
+        sk.add(vals)
+        sk.get_quantile_values(QS)
+    other.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
+    sk.merge(other)
+    blobs = wire.state_to_bytes(spec, sk.state)
+    wire.bytes_to_state(spec, blobs)
+
+    snap = telemetry.snapshot()
+    path = os.path.join(outdir, f"snap{shard}.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(snap, f, indent=1, sort_keys=True)
+        f.write("\n")
+    acc = accuracy.summary()
+    print(
+        f"shard {shard}: {int(acc['audits'])} audits,"
+        f" {int(acc['violations'])} violations -> {path}"
+    )
+
+
+def _fmt_s(v) -> str:
+    return "-" if v is None else f"{v * 1e3:8.3f} ms"
+
+
+def run_fleet(n_shards: int, outdir: str) -> int:
+    """Spawn the shards, merge their snapshots, print the dashboard."""
+    # Sequential shards: CI runners have two cores, and N concurrent
+    # jax processes contending for them would bill scheduler noise to
+    # the latency SLOs.  A real fleet's shards own their hosts.
+    env = dict(os.environ)
+    for s in range(n_shards):
+        rc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker", str(s), "--outdir", outdir],
+            env=env,
+        ).returncode
+        if rc != 0:
+            print(f"fleet: shard {s} failed (rc={rc}); no merged verdict")
+            return 1
+
+    from sketches_tpu import telemetry
+
+    snaps = []
+    for s in range(n_shards):
+        with open(os.path.join(outdir, f"snap{s}.json"), encoding="utf-8") as f:
+            snaps.append(json.load(f))
+    merged = telemetry.merge_snapshots(*snaps)
+    merged_path = os.path.join(outdir, "fleet-merged.json")
+    with open(merged_path, "w", encoding="utf-8") as f:
+        json.dump(merged, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+    print(f"\n== fleet histograms ({merged['merged_from']} shards merged,"
+          f" alpha={merged['histogram_relative_accuracy']}) ==")
+    for name in sorted(merged["histograms"]):
+        h = merged["histograms"][name]
+        print(
+            f"  {name:55s} n={h['count']:7.0f}"
+            f" p50={_fmt_s(h['p50'])} p99={_fmt_s(h['p99'])}"
+        )
+
+    prof = merged.get("profiling") or {}
+    rows = prof.get("attribution") or []
+    print("\n== device-time attribution (merged measured vs roofline) ==")
+    attribution_path = os.path.join(outdir, "attribution.json")
+    with open(attribution_path, "w", encoding="utf-8") as f:
+        json.dump(prof, f, indent=1, sort_keys=True)
+        f.write("\n")
+    measured = prof.get("measured") or {}
+    for key in sorted(measured):
+        m = measured[key]
+        print(
+            f"  {key:18s} calls={m['calls']:6.0f}"
+            f" total={m['total_s']:8.4f}s mean={_fmt_s(m.get('mean_s'))}"
+        )
+    for row in rows:
+        if row.get("x_roofline") is not None:
+            print(
+                f"  {row['phase']}/{row['tier']} -> {row['entry']}:"
+                f" {row['x_roofline']:.0f}x above the declared roofline"
+            )
+
+    print("\n== SLO verdict ==")
+    lines, burning, evaluated = telemetry.check_slo(merged)
+    for line in lines:
+        print(line)
+    print(
+        f"fleet: merged snapshot -> {merged_path};"
+        f" attribution -> {attribution_path}"
+    )
+    if evaluated == 0:
+        print("fleet: no SLO was evaluable (empty snapshots?)")
+        return 1
+    if burning:
+        print(f"fleet: {burning}/{evaluated} SLO(s) BURNING")
+        return 1
+    print(f"fleet: {evaluated} SLO(s) within budget")
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--shards", type=int, default=3)
+    parser.add_argument("--outdir", default=None)
+    parser.add_argument("--worker", type=int, default=None,
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args()
+    if args.worker is not None:
+        run_shard(args.worker, args.outdir or tempfile.gettempdir())
+        return 0
+    outdir = args.outdir or tempfile.mkdtemp(prefix="fleet_dashboard_")
+    os.makedirs(outdir, exist_ok=True)
+    return run_fleet(args.shards, outdir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,7 @@ Two execution tiers:
   or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
@@ -54,7 +54,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.9.0"
+__version__ = "0.10.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -79,8 +79,13 @@ __all__ = [
     # Resilience layer (error taxonomy, fault injection, health ledger)
     "resilience",
     "faults",
-    # Telemetry layer (self-sketching metrics, spans, exporters)
+    # Telemetry layer (self-sketching metrics, spans, exporters,
+    # mergeable snapshots, SLO gate)
     "telemetry",
+    # Device-time attribution (block_until_ready per-tier/phase timers)
+    "profiling",
+    # Accuracy-drift shadow audit (reservoir samples vs the alpha contract)
+    "accuracy",
     # Integrity layer (invariant checks, fingerprints, repair)
     "integrity",
     "IntegrityError",

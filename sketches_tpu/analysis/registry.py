@@ -32,6 +32,8 @@ __all__ = [
     "FAULTS",
     "TELEMETRY",
     "INTEGRITY",
+    "PROFILING",
+    "ACCURACY_AUDIT",
     "REGISTRY",
     "declared",
     "get",
@@ -116,11 +118,41 @@ INTEGRITY = EnvVar(
     ),
 )
 
+#: Device-time profiling arming (``sketches_tpu.profiling``).
+PROFILING = EnvVar(
+    name="SKETCHES_TPU_PROFILING",
+    default="0",
+    owner="sketches_tpu.profiling",
+    doc=(
+        "Set to 1 to arm device-time attribution: every engine dispatch"
+        " blocks until the device finishes and the time is attributed per"
+        " engine tier and phase; 0/unset leaves it off -- one bool test"
+        " per dispatch."
+    ),
+)
+
+#: Accuracy-drift shadow audit arming (``sketches_tpu.accuracy``).
+ACCURACY_AUDIT = EnvVar(
+    name="SKETCHES_TPU_ACCURACY_AUDIT",
+    default="0",
+    owner="sketches_tpu.accuracy",
+    doc=(
+        "Set to 1 to arm the accuracy-drift shadow audit: watched sketches"
+        " keep a bounded reservoir sample and periodically verify realized"
+        " quantile error against the alpha contract; 0/unset leaves it off"
+        " -- one bool test per ingest."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
 REGISTRY: Dict[str, EnvVar] = {
-    v.name: v for v in (NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY)
+    v.name: v
+    for v in (
+        NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
+        ACCURACY_AUDIT,
+    )
 }
 
 

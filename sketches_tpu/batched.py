@@ -52,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
 from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
 from sketches_tpu.resilience import SketchValueError, SpecError
@@ -1115,6 +1115,7 @@ class BatchedDDSketch:
         :meth:`add_validated` to reject negative weights eagerly instead.
         """
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        _p0 = telemetry.clock() if profiling._ACTIVE else None
         _eng = "xla"
         values = jnp.asarray(values)
         if weights is not None:
@@ -1210,6 +1211,13 @@ class BatchedDDSketch:
                 "ingest_s", _t0, component="batched", engine=_eng
             )
             telemetry.counter_inc("batched.ingest_batches")
+        # Device-clocked attribution AFTER the host span closes: the
+        # telemetry span keeps measuring submission, the profiling
+        # record blocks for execution.
+        if _p0 is not None:
+            profiling.record("ingest", _eng, _p0, self.state)
+        if accuracy._ACTIVE:
+            accuracy.observe_ingest(self, values, weights)
         return self
 
     def add_validated(self, values, weights=None) -> "BatchedDDSketch":
@@ -1361,11 +1369,14 @@ class BatchedDDSketch:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
                 _t0 = telemetry.clock() if telemetry._ACTIVE else None
+                _p0 = telemetry.clock() if profiling._ACTIVE else None
                 out = fn(self.state, qs_arr)
                 if _t0 is not None:
                     telemetry.finish_span(
                         "query_s", _t0, component="batched", tier=tier
                     )
+                if _p0 is not None:
+                    profiling.record("query", tier, _p0, out)
                 return out
             except Exception as e:
                 if not self._demote_query(tier, e):
@@ -1407,6 +1418,7 @@ class BatchedDDSketch:
                 "Cannot merge two batched sketches with different specs"
             )
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        _p0 = telemetry.clock() if profiling._ACTIVE else None
         # Guarded integrity seam: snapshot operand fingerprints before
         # the donated merge consumes the buffers, verify the result
         # against them after (raise/quarantine per the armed mode).
@@ -1420,6 +1432,8 @@ class BatchedDDSketch:
             integrity.postmerge(self.spec, self.state, _ipre, seam="batched.merge")
         if _t0 is not None:
             telemetry.finish_span("merge_s", _t0, component="batched")
+        if _p0 is not None:
+            profiling.record("fold", "merge", _p0, self.state)
         self._invalidate_plans()
         # A merge that brings mass populates the batch: a still-pending
         # first-batch auto-center would recenter away from that mass.  An

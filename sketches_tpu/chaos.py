@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from sketches_tpu import faults, integrity, resilience
+from sketches_tpu import faults, integrity, resilience, telemetry
 from sketches_tpu.resilience import (
     CheckpointCorrupt,
     InjectedFault,
@@ -415,6 +415,12 @@ def run_campaign(
             "final_count": final,
             "integrity_reports": len(integrity.reports()),
             "health": resilience.health(),
+            # The end-of-campaign telemetry snapshot rides the verdict
+            # when the metrics layer is armed (the CI chaos job), so the
+            # artifact carries the integrity.*/resilience.* counters --
+            # and stays mergeable with the other jobs' snapshots.  None
+            # (not {}) when disarmed: an absent layer, not an idle one.
+            "telemetry": telemetry.snapshot() if telemetry.enabled() else None,
         }
     finally:
         faults.disarm()

@@ -32,7 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
 from sketches_tpu.batched import (
     BatchedDDSketch,
     SketchSpec,
@@ -560,6 +560,7 @@ class DistributedDDSketch:
         Use ``weights == 0`` entries to pad ragged batches to a multiple.
         """
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        _p0 = telemetry.clock() if profiling._ACTIVE else None
         values = jnp.asarray(values)
         if values.ndim == 1:
             values = values[:, None]
@@ -621,6 +622,10 @@ class DistributedDDSketch:
                 "ingest_s", _t0, component="distributed", engine="shard_map"
             )
             telemetry.counter_inc("distributed.ingest_batches")
+        if _p0 is not None:
+            profiling.record("ingest", "shard_map", _p0, self.partials)
+        if accuracy._ACTIVE:
+            accuracy.observe_ingest(self, values, weights)
         return self
 
     def merged_state(self) -> SketchState:
@@ -631,9 +636,12 @@ class DistributedDDSketch:
         """
         if self._merged_cache is None:
             _t0 = telemetry.clock() if telemetry._ACTIVE else None
+            _p0 = telemetry.clock() if profiling._ACTIVE else None
             self._merged_cache = self._fold(self.partials)
             if _t0 is not None:
                 telemetry.finish_span("distributed.fold_s", _t0)
+            if _p0 is not None:
+                profiling.record("fold", "psum", _p0, self._merged_cache)
             if integrity._ACTIVE:
                 # Parallel checksum lane over the psum fold: the shard
                 # fingerprints must sum to the folded fingerprint.
@@ -857,11 +865,14 @@ class DistributedDDSketch:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
                 st = self.merged_state()
                 _t0 = telemetry.clock() if telemetry._ACTIVE else None
+                _p0 = telemetry.clock() if profiling._ACTIVE else None
                 out = fn(st, qs_arr)
                 if _t0 is not None:
                     telemetry.finish_span(
                         "query_s", _t0, component="distributed", tier=tier
                     )
+                if _p0 is not None:
+                    profiling.record("query", tier, _p0, out)
                 return out
             except Exception as e:
                 nxt = resilience.demote_query_tier(self._query_disabled, tier)
@@ -903,6 +914,7 @@ class DistributedDDSketch:
         a_st = self.merged_state()
         b_st = other.merged_state()
         _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        _p0 = telemetry.clock() if profiling._ACTIVE else None
         # Guarded integrity seam on the FOLDED states (the partials'
         # consistency is covered by the fold lane above).
         _ipre = (
@@ -919,6 +931,8 @@ class DistributedDDSketch:
         self._partials = self._merge_partials(self._partials, other_aligned)
         if _t0 is not None:
             telemetry.finish_span("merge_s", _t0, component="distributed")
+        if _p0 is not None:
+            profiling.record("fold", "merge", _p0, self._partials)
         self._merged_cache = None
         self._invalidate_plans()
         if _ipre is not None:

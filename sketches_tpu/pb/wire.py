@@ -41,7 +41,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import faults, integrity, profiling, resilience, telemetry
 from sketches_tpu.batched import (
     SketchSpec,
     SketchState,
@@ -159,6 +159,7 @@ def state_to_bytes(spec: SketchSpec, state: SketchState) -> List[bytes]:
     import jax
 
     _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    _p0 = telemetry.clock() if profiling._ACTIVE else None
     if integrity._ACTIVE:
         # Guarded seam: refuse to ship a corrupted state onto the wire
         # (raise/quarantine per the armed mode).  The wire format itself
@@ -207,6 +208,10 @@ def state_to_bytes(spec: SketchSpec, state: SketchState) -> List[bytes]:
     if _t0 is not None:
         telemetry.finish_span("wire.encode_s", _t0)
         telemetry.counter_inc("wire.blobs_encoded", float(len(blobs)))
+    if _p0 is not None:
+        # The device_get above already synced; attribute the host-side
+        # codec walk to the decode phase's encode tier.
+        profiling.record("decode", "encode", _p0)
     return blobs
 
 
@@ -618,6 +623,7 @@ def bytes_to_state(
             " 'quarantine'"
         )
     _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    _p0 = telemetry.clock() if profiling._ACTIVE else None
     report = QuarantineReport(total=len(blobs)) if errors == "quarantine" else None
     dec = _Decoder(spec, len(blobs))
     expected_mapping = _mapping_field(spec)
@@ -711,6 +717,8 @@ def bytes_to_state(
     if _t0 is not None:
         telemetry.finish_span("wire.decode_s", _t0, errors=errors)
         telemetry.counter_inc("wire.blobs_decoded", float(len(blobs)))
+    if _p0 is not None:
+        profiling.record("decode", "decode", _p0, state)
     if report is None:
         return state
     if report.n_quarantined:

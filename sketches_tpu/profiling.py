@@ -1,0 +1,401 @@
+"""Device-time attribution: where the accelerator's time actually goes.
+
+The telemetry layer (``telemetry.py``) host-times every dispatch -- but
+an async dispatch returns before the device finishes, so host spans
+measure *submission*, not *execution*.  This layer wraps the engine
+dispatch seams in **device-clocked** timers using ``bench.py``'s
+``block_until_ready`` discipline: when armed, each instrumented
+dispatch blocks until its result materializes and the elapsed time is
+attributed per **engine tier** (overlap / tiles / windowed / wxla /
+xla / pallas / psum) and per **phase** (ingest / fold / query /
+decode).  Three surfaces:
+
+* :func:`attribution` -- the measured table (calls, total/mean/min/max
+  seconds per ``phase/tier``) joined against a **roofline estimate**
+  per engine entry point: the traced jaxprs from
+  ``analysis/jaxpr_audit.py``'s audited surface are walked for
+  estimated flops and top-level boundary bytes, giving
+  ``max(bytes/peak_bw, flops/peak_flops)`` as the light-speed time and
+  ``x_roofline`` as how far each measured mean sits above it.
+* ``telemetry.snapshot()["profiling"]`` -- the same table rides every
+  armed snapshot (and survives :func:`telemetry.merge_snapshots`:
+  measured calls/time fold by sum, fleet-wide device-time percentiles
+  come from the ``profiling.device_s`` histogram this layer feeds).
+* ``telemetry.chrome_trace()`` -- armed dispatches append ``X`` events
+  on a second process track (pid 2, one thread per engine tier): the
+  device timeline next to the host spans in one viewer.
+
+Arming: OFF by default.  ``SKETCHES_TPU_PROFILING=1`` (declared in
+``analysis/registry.py``) arms at process start; :func:`enable` /
+:func:`disable` arm programmatically.  Cost discipline mirrors
+``faults``/``telemetry``: every seam guards on ``profiling._ACTIVE``,
+so the disarmed layer costs one attribute read + bool test per
+dispatch -- no clock read, no allocation, and crucially **no forced
+device sync** (blocking is the whole point when armed, and the whole
+hazard when not).
+
+Failure modes: the roofline estimator traces on demand and NEVER takes
+the process down -- a trace failure lands as an ``"error"`` entry in
+the roofline table instead of raising; the event ring is bounded (65k)
+and drops-with-count like the telemetry span ring; peak numbers are
+*declared* nominal hardware ceilings (TPU v4 by default), so
+``x_roofline`` on other backends is a relative, not absolute, measure.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from sketches_tpu import telemetry
+from sketches_tpu.analysis import registry
+
+__all__ = [
+    "PROFILING_ENV",
+    "PEAK_FLOPS_PER_S",
+    "PEAK_HBM_BYTES_PER_S",
+    "enable",
+    "disable",
+    "enabled",
+    "reset",
+    "record",
+    "attribution",
+    "roofline",
+    "chrome_events",
+]
+
+#: Declared in ``analysis/registry.py`` (the kill-switch inventory).
+PROFILING_ENV = registry.PROFILING.name
+
+#: Nominal peak arithmetic throughput the roofline is drawn against
+#: (TPU v4 bf16 peak).  On other backends ``x_roofline`` stays a
+#: relative measure against this declared ceiling.
+PEAK_FLOPS_PER_S = 275e12
+
+#: Nominal HBM read bandwidth the roofline is drawn against (TPU v4).
+PEAK_HBM_BYTES_PER_S = 1.2e12
+
+#: Fast-path guard: instrumented seams check this module flag before
+#: doing any profiling work (one bool test per dispatch disarmed).
+_ACTIVE = registry.enabled(registry.PROFILING)
+
+_MAX_EVENTS = 65536
+
+_lock = threading.Lock()
+_stats: Dict[Tuple[str, str], Dict[str, float]] = {}
+_events: List[dict] = []
+_events_dropped = 0
+_tier_tids: Dict[str, int] = {}
+_roofline_cache: Optional[Dict[str, dict]] = None
+
+#: Which audited entry point (``analysis/jaxpr_audit.py``) each measured
+#: ``(phase, tier)`` pair dispatches into -- the join key between the
+#: measured table and the roofline table.
+_TIER_ENTRY: Dict[Tuple[str, str], str] = {
+    ("query", "overlap"): "kernels.fused_quantile_tiles_overlap",
+    ("query", "tiles"): "kernels.fused_quantile_tiles",
+    ("query", "windowed"): "kernels.fused_quantile_windowed",
+    ("query", "wxla"): "kernels.quantile_windowed_xla",
+    ("query", "xla"): "batched.quantile",
+    ("ingest", "pallas"): "kernels.ingest_histogram",
+    ("ingest", "xla"): "batched.add",
+    ("ingest", "recenter"): "batched.add",
+    ("ingest", "shard_map"): "batched.add",
+    ("fold", "merge"): "batched.merge",
+    ("fold", "psum"): "batched.merge",
+}
+
+
+def enable(on: bool = True) -> None:
+    """Arm (or, with ``on=False``, disarm) device-time attribution.
+
+    Never raises; recorded attribution is kept (:func:`reset` clears).
+    Arming makes every instrumented dispatch BLOCK until the device
+    finishes -- that synchronization is the measurement, and the reason
+    the layer is off by default.
+    """
+    global _ACTIVE
+    _ACTIVE = bool(on)
+
+
+def disable() -> None:
+    """Disarm the profiling layer (seams go back to one bool test per
+    dispatch, no forced device sync; recorded state is kept)."""
+    enable(False)
+
+
+def enabled() -> bool:
+    """Whether the layer is armed (env switch or :func:`enable`);
+    False -- the default -- means no seam blocks or records anything."""
+    return _ACTIVE
+
+
+def reset() -> None:
+    """Clear the measured table and the device-track event ring (test
+    isolation hook; the roofline cache is kept -- it is static per
+    build).  Never raises."""
+    global _events_dropped
+    with _lock:
+        _stats.clear()
+        _events.clear()
+        _tier_tids.clear()
+        _events_dropped = 0
+
+
+def record(phase: str, tier: str, t0: float, sync: Any = None) -> float:
+    """Close a device-clocked dispatch opened at ``t0 = telemetry.clock()``.
+
+    Blocks until ``sync`` (the dispatch's output pytree; ``None`` for
+    host-side phases like the wire codec) is ready -- bench.py's
+    ``block_until_ready`` discipline -- then attributes the elapsed
+    time to ``(phase, tier)``, feeds the mergeable
+    ``profiling.device_s`` telemetry histogram, and appends one
+    device-track trace event.  The seam idiom mirrors the hot-path
+    telemetry spans::
+
+        _p0 = telemetry.clock() if profiling._ACTIVE else None
+        out = fn(...)
+        if _p0 is not None:
+            profiling.record("query", tier, _p0, out)
+
+    Returns the measured seconds.  Never raises on an unsyncable
+    ``sync`` (a host value passes through); while disarmed it records
+    nothing and returns 0.0.
+    """
+    global _events_dropped
+    if not _ACTIVE:
+        return 0.0
+    if sync is not None:
+        try:
+            import jax
+
+            jax.block_until_ready(sync)
+        except Exception:  # noqa: BLE001 - host values pass through unsynced
+            pass
+    now = telemetry.clock()
+    dur = max(now - t0, 0.0)
+    key = (phase, tier)
+    with _lock:
+        st = _stats.get(key)
+        if st is None:
+            st = _stats[key] = {
+                "calls": 0.0, "total_s": 0.0,
+                "min_s": math.inf, "max_s": -math.inf,
+            }
+        st["calls"] += 1.0
+        st["total_s"] += dur
+        if dur < st["min_s"]:
+            st["min_s"] = dur
+        if dur > st["max_s"]:
+            st["max_s"] = dur
+        tid = _tier_tids.get(tier)
+        if tid is None:
+            tid = _tier_tids[tier] = len(_tier_tids) + 1
+        if len(_events) < _MAX_EVENTS:
+            _events.append(
+                {
+                    "name": f"{phase}/{tier}",
+                    "cat": "sketches_tpu.device",
+                    "ph": "X",
+                    "ts": (t0 - telemetry._epoch_pc) * 1e6,
+                    "dur": dur * 1e6,
+                    "pid": 2,
+                    "tid": tid,
+                    "args": {"phase": phase, "tier": tier},
+                }
+            )
+        else:
+            _events_dropped += 1
+    telemetry.observe("profiling.device_s", dur, phase=phase, tier=tier)
+    return dur
+
+
+def chrome_events() -> List[dict]:
+    """The device-track Chrome-trace events (pid 2 metadata + ``X``
+    events), ready to splice into ``telemetry.chrome_trace()``.  An
+    empty list (bar the process metadata) is the idle steady state."""
+    with _lock:
+        events = list(_events)
+        tids = dict(_tier_tids)
+    meta: List[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 2,
+            "args": {"name": "sketches_tpu device (profiling)"},
+        }
+    ]
+    for tier, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 2,
+                "tid": tid,
+                "args": {"name": f"tier-{tier}"},
+            }
+        )
+    return meta + events
+
+
+# ---------------------------------------------------------------------------
+# Roofline estimation (reuses the jaxpr-audit traced surface)
+# ---------------------------------------------------------------------------
+
+
+def _eqn_flops(eqn) -> float:
+    """Rough per-equation flop estimate: 1 op per output element for
+    elementwise work, ``2*out*K`` for ``dot_general`` (the MXU path),
+    input-sized for reductions/scans.  An *estimate* by construction --
+    good to well under the order of magnitude the roofline needs."""
+    import numpy as np
+
+    def size(v) -> int:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        if shape is None:
+            return 0
+        return int(np.prod(shape)) if shape else 1
+
+    prim = eqn.primitive.name
+    out = sum(size(v) for v in eqn.outvars)
+    if prim == "dot_general":
+        dnums = eqn.params.get("dimension_numbers")
+        try:
+            (lhs_contract, _), _ = dnums
+            lhs_shape = eqn.invars[0].aval.shape
+            k = 1
+            for d in lhs_contract:
+                k *= int(lhs_shape[d])
+            return 2.0 * out * k
+        except Exception:  # noqa: BLE001 - fall back to elementwise cost
+            return float(out)
+    if prim.startswith(("reduce_", "cum", "argm", "scan", "sort")):
+        return float(sum(size(v) for v in eqn.invars))
+    return float(out)
+
+
+def _entry_costs(name: str, fn, args) -> dict:
+    """Trace one audited entry point -> estimated flops, boundary bytes,
+    arithmetic intensity, and roofline seconds at the audited shape.
+    A trace failure is reported in-row (``{"error": ...}``), not raised.
+    """
+    import jax
+    import numpy as np
+
+    from sketches_tpu.analysis.jaxpr_audit import _iter_jaxprs
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:  # noqa: BLE001 - the row carries the failure
+        return {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    flops = 0.0
+    for sub in _iter_jaxprs(closed.jaxpr):
+        for eqn in sub.eqns:
+            flops += _eqn_flops(eqn)
+
+    def nbytes(v) -> int:
+        aval = getattr(v, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            return 0
+        n = int(np.prod(shape)) if shape else 1
+        return n * np.dtype(dtype).itemsize
+
+    bytes_ = float(
+        sum(nbytes(v) for v in closed.jaxpr.invars)
+        + sum(nbytes(v) for v in closed.jaxpr.outvars)
+    )
+    roofline_s = max(
+        flops / PEAK_FLOPS_PER_S, bytes_ / PEAK_HBM_BYTES_PER_S
+    )
+    return {
+        "flops": flops,
+        "bytes": bytes_,
+        "intensity_flops_per_byte": (flops / bytes_) if bytes_ else None,
+        "roofline_s": roofline_s,
+    }
+
+
+def roofline(refresh: bool = False) -> Dict[str, dict]:
+    """Per-entry-point roofline table over the jaxpr-audit surface
+    (``analysis/jaxpr_audit.default_entry_points``), cached after the
+    first call.  Entry points that fail to trace carry an ``"error"``
+    row instead of raising; an entirely untraceable surface (no jax)
+    returns ``{"error": ...}``."""
+    global _roofline_cache
+    if _roofline_cache is not None and not refresh:
+        return _roofline_cache
+    try:
+        from sketches_tpu.analysis.jaxpr_audit import default_entry_points
+
+        table = {
+            name: _entry_costs(name, fn, args)
+            for name, fn, args in default_entry_points()
+        }
+    except Exception as e:  # noqa: BLE001 - attribution must not crash
+        table = {"error": f"{type(e).__name__}: {str(e)[:200]}"}
+    _roofline_cache = table
+    return table
+
+
+def attribution() -> dict:
+    """The measured-vs-roofline attribution table (JSON-safe).
+
+    ``measured`` maps ``"phase/tier"`` to call counts and device-clocked
+    seconds; ``attribution`` joins each measured row against its entry
+    point's roofline estimate (``x_roofline`` = measured mean over the
+    light-speed time -- how far the dispatch sits above the declared
+    hardware ceiling).  Empty tables are the disarmed/idle steady
+    state; roofline rows may carry ``"error"`` entries for entry points
+    that failed to trace (never raises).
+    """
+    with _lock:
+        measured = {
+            f"{phase}/{tier}": {
+                "phase": phase,
+                "tier": tier,
+                "calls": st["calls"],
+                "total_s": st["total_s"],
+                "mean_s": st["total_s"] / st["calls"] if st["calls"] else None,
+                "min_s": None if math.isinf(st["min_s"]) else st["min_s"],
+                "max_s": None if math.isinf(st["max_s"]) else st["max_s"],
+            }
+            for (phase, tier), st in _stats.items()
+        }
+        dropped = _events_dropped
+    roof = roofline()
+    rows = []
+    for key, row in sorted(measured.items()):
+        entry = _TIER_ENTRY.get((row["phase"], row["tier"]))
+        r = roof.get(entry) if entry else None
+        roofline_s = r.get("roofline_s") if isinstance(r, dict) else None
+        mean = row["mean_s"]
+        rows.append(
+            {
+                "phase": row["phase"],
+                "tier": row["tier"],
+                "entry": entry,
+                "calls": row["calls"],
+                "total_s": row["total_s"],
+                "mean_s": mean,
+                "roofline_s": roofline_s,
+                "x_roofline": (
+                    mean / roofline_s
+                    if mean is not None and roofline_s
+                    else None
+                ),
+            }
+        )
+    return {
+        "measured": measured,
+        "roofline": roof,
+        "attribution": rows,
+        "peaks": {
+            "flops_per_s": PEAK_FLOPS_PER_S,
+            "hbm_bytes_per_s": PEAK_HBM_BYTES_PER_S,
+        },
+        "events_dropped": dropped,
+    }

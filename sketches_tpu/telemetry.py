@@ -36,17 +36,37 @@ Wall-clock reads live ONLY in this module (:func:`clock` /
 :func:`wall_time`): the sketchlint ``determinism`` rule carves out
 ``telemetry.py`` and keeps flagging clocks everywhere else.
 
+Fleet semantics (r11): snapshots are **mergeable**.  Every histogram
+summary embeds its sketch's sparse bin state, so
+:func:`merge_snapshots` folds N per-process snapshots into one
+fleet-wide snapshot -- counters by sum, gauges by their declared
+``merge`` policy, histograms by DDSketch bin addition -- and the merged
+p50/p99 carry the SAME ``HISTOGRAM_REL_ACC`` relative-error guarantee
+as a single process (the paper's mergeability property, applied to the
+library's own telemetry).  A declared :data:`SLOS` inventory (target +
+window + burn-rate threshold per metric) is evaluated by
+:func:`check_slo` against any snapshot, merged or not.
+
 CLI: ``python -m sketches_tpu.telemetry --check-bench OLD NEW`` is the
 bench regression gate -- it compares two ``bench.py`` summary documents
 (e.g. the checked-in ``BENCH_local_r*.json``) metric by metric against
 per-metric thresholds and exits non-zero on regression.
+``--merge A.json B.json ... [--out M.json]`` folds snapshot files;
+``--check-slo SNAPSHOT.json`` evaluates the SLO inventory (exit 1 on
+any burning SLO, 2 when nothing was evaluable); ``--bench-snapshot
+BENCH.json OUT.json`` derives a snapshot from a bench summary's
+measured latencies (the checked-in SLO-gate fixture).
 
 Failure modes: recording against an undeclared metric name (or the
 wrong kind) raises ``SketchValueError`` -- stringly-typed drift is
 refused, not collected; a full trace ring drops the newest events and
-counts them (``snapshot()['spans']['dropped']``); ``--check-bench``
-exits 1 on any regressed metric and 2 when the documents share no
-comparable metric at all (wrong files beat a silent pass).
+counts them (``snapshot()['spans']['dropped']`` and the declared
+``spans.dropped`` counter); merging snapshots with different histogram
+relative accuracies (or pre-r11 snapshots without embedded bin state)
+raises ``SketchValueError`` -- a silent accuracy downgrade is refused;
+``--check-bench`` exits 1 on any regressed metric and 2 when the
+documents share no comparable metric at all (wrong files beat a silent
+pass), and ``--check-slo`` mirrors that contract.
 """
 
 from __future__ import annotations
@@ -79,9 +99,14 @@ __all__ = [
     "span",
     "event",
     "snapshot",
+    "merge_snapshots",
     "prometheus_text",
     "chrome_trace",
     "check_bench",
+    "SLO",
+    "SLOS",
+    "check_slo",
+    "snapshot_from_bench",
     "main",
 ]
 
@@ -103,12 +128,18 @@ class Metric:
     wins), or ``"histogram"`` (DDSketch-backed distribution of seconds;
     spans feed these).  Recording against a name whose declared kind
     does not match the API used raises ``SketchValueError``.
+
+    ``merge`` is the gauge fold policy :func:`merge_snapshots` applies
+    across processes (``"max"``, ``"min"``, or ``"sum"``); counters
+    always fold by sum and histograms by sketch merge, so the field
+    only matters for gauges.
     """
 
     name: str
     kind: str
     owner: str
     doc: str
+    merge: str = "max"
 
 
 # The library's metric inventory.  The sketchlint ``telemetry-names``
@@ -163,6 +194,22 @@ _DECLARED = (
            "Checkpoint serialize+fsync+rename wall time."),
     Metric("checkpoint.restore_s", "histogram", "sketches_tpu.checkpoint",
            "Checkpoint load+validate wall time."),
+    Metric("spans.dropped", "counter", "sketches_tpu.telemetry",
+           "Trace events dropped because the 65k span ring was full."),
+    Metric("profiling.device_s", "histogram", "sketches_tpu.profiling",
+           "Device-clocked (block_until_ready) dispatch time, attributed"
+           " per phase and engine tier (labels: phase, tier)."),
+    Metric("accuracy.audits", "counter", "sketches_tpu.accuracy",
+           "Shadow-audit passes run against watched sketches."),
+    Metric("accuracy.violations", "counter", "sketches_tpu.accuracy",
+           "Audit passes where a realized quantile broke the alpha"
+           " contract against the reservoir sample."),
+    Metric("accuracy.rel_err", "gauge", "sketches_tpu.accuracy",
+           "Worst realized relative quantile error seen by the most"
+           " recent audit pass (label: stream)."),
+    Metric("accuracy.collapsed_mass_frac", "gauge", "sketches_tpu.accuracy",
+           "Fraction of a watched stream's mass clamped into the window"
+           " edge bins at the most recent audit (label: stream)."),
 )
 
 #: Every declared metric by name (static inventory + runtime
@@ -170,6 +217,7 @@ _DECLARED = (
 METRICS: Dict[str, Metric] = {m.name: m for m in _DECLARED}
 
 _VALID_KINDS = ("counter", "gauge", "histogram")
+_VALID_MERGES = ("max", "min", "sum")
 
 _lock = threading.Lock()
 
@@ -204,18 +252,26 @@ def _raise_value_error(msg: str) -> None:
     raise SketchValueError(msg)
 
 
-def declare(name: str, kind: str, doc: str, owner: str = "user") -> Metric:
+def declare(
+    name: str, kind: str, doc: str, owner: str = "user", merge: str = "max"
+) -> Metric:
     """Register a user-space metric (examples, applications, tests).
 
     Library code must use the static inventory instead (the sketchlint
     ``telemetry-names`` rule refuses in-package ``declare`` calls).
-    Raises ``SketchValueError`` on an invalid kind; re-declaring an
-    existing name with a different kind raises, an identical
-    re-declaration is a no-op.
+    ``merge`` is the cross-process gauge fold policy (gauges only; see
+    :class:`Metric`).  Raises ``SketchValueError`` on an invalid kind or
+    merge policy; re-declaring an existing name with a different kind
+    raises, an identical re-declaration is a no-op.
     """
     if kind not in _VALID_KINDS:
         _raise_value_error(
             f"Unknown metric kind {kind!r}; expected one of {_VALID_KINDS}"
+        )
+    if merge not in _VALID_MERGES:
+        _raise_value_error(
+            f"Unknown gauge merge policy {merge!r}; expected one of"
+            f" {_VALID_MERGES}"
         )
     with _lock:
         prev = METRICS.get(name)
@@ -226,7 +282,7 @@ def declare(name: str, kind: str, doc: str, owner: str = "user") -> Metric:
                     f" {prev.kind!r}"
                 )
             return prev
-        m = Metric(name, kind, owner, doc)
+        m = Metric(name, kind, owner, doc, merge)
         METRICS[name] = m
         return m
 
@@ -322,6 +378,41 @@ def wall_time() -> float:
 # ---------------------------------------------------------------------------
 
 
+def _sketch_state(sk) -> dict:
+    """A host DDSketch's sparse bin state as a JSON-safe dict
+    (``{"zero_count", "pos": {key: mass}, "neg": {key: mass}}``)."""
+
+    def bins(store) -> Dict[str, float]:
+        return {
+            str(k): float(store.bins[k - store.offset]) for k in store.keys()
+        }
+
+    return {
+        "zero_count": float(sk.zero_count),
+        "pos": bins(sk.store),
+        "neg": bins(sk.negative_store),
+    }
+
+
+def _sketch_from_state(state: dict, rel_acc: float):
+    """Rebuild a host DDSketch from :func:`_sketch_state` output (bin
+    mass and zero count only; scalar min/max/sum are the caller's)."""
+    from sketches_tpu.ddsketch import BaseDDSketch
+    from sketches_tpu.mapping import LogarithmicMapping
+    from sketches_tpu.store import DenseStore
+
+    sk = BaseDDSketch(
+        LogarithmicMapping(rel_acc), DenseStore(), DenseStore(),
+        zero_count=float(state.get("zero_count", 0.0)),
+    )
+    for key, cnt in state.get("pos", {}).items():
+        sk.store.add(int(key), float(cnt))
+    for key, cnt in state.get("neg", {}).items():
+        sk.negative_store.add(int(key), float(cnt))
+    sk._count = sk.zero_count + sk.store.count + sk.negative_store.count
+    return sk
+
+
 class _Hist:
     """One histogram: a host-tier DDSketch plus exact min/max.
 
@@ -359,6 +450,11 @@ class _Hist:
         for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
                          (0.999, "p999")):
             out[label] = sk.get_quantile_value(q)
+        # The sketch's sparse bin state rides along (JSON-safe: string
+        # keys), so snapshots are MERGEABLE: merge_snapshots folds these
+        # bins by key addition -- exactly DDSketch.merge -- and the
+        # fleet-wide quantiles keep the alpha contract.
+        out["state"] = _sketch_state(sk)
         return out
 
 
@@ -416,11 +512,15 @@ def _tid() -> int:
 
 
 def _append_event(ev: dict) -> None:
+    # Caller holds ``_lock``: the drop counter mutates ``_counters``
+    # directly (``counter_inc`` would deadlock re-acquiring the lock).
     global _events_dropped
     if len(_events) < _MAX_EVENTS:
         _events.append(ev)
     else:
         _events_dropped += 1
+        k = ("spans.dropped", ())
+        _counters[k] = _counters.get(k, 0.0) + 1.0
 
 
 def finish_span(name: str, t0: float, **labels) -> float:
@@ -548,7 +648,12 @@ def snapshot() -> dict:
     ``resilience.health()`` rides along verbatim under ``"resilience"``,
     so demotion counters and the ledger can never disagree in one
     artifact; an empty snapshot (no counters, no histograms) is the
-    disarmed/idle steady state, not an error.
+    disarmed/idle steady state, not an error.  When the profiling or
+    accuracy-audit layers are armed their sections ride along too
+    (``"profiling"``: the measured-vs-roofline attribution table,
+    ``"accuracy"``: the drift-audit summary).  Every histogram summary
+    embeds its sparse bin state, so snapshots written to disk stay
+    foldable by :func:`merge_snapshots` / ``--merge``.
     """
     with _lock:
         counters = {_render_key(k): v for k, v in _counters.items()}
@@ -557,7 +662,7 @@ def snapshot() -> dict:
         spans = {"n_events": len(_events), "dropped": _events_dropped}
     from sketches_tpu import resilience
 
-    return {
+    out = {
         "enabled": _ACTIVE,
         "histogram_relative_accuracy": HISTOGRAM_REL_ACC,
         "counters": counters,
@@ -566,6 +671,15 @@ def snapshot() -> dict:
         "spans": spans,
         "resilience": resilience.health(),
     }
+    from sketches_tpu import profiling as _profiling
+
+    if _profiling._ACTIVE:
+        out["profiling"] = _profiling.attribution()
+    from sketches_tpu import accuracy as _accuracy
+
+    if _accuracy._ACTIVE:
+        out["accuracy"] = _accuracy.summary()
+    return out
 
 
 def _prom_name(name: str) -> str:
@@ -634,8 +748,10 @@ def chrome_trace() -> dict:
 
     Same ``traceEvents`` conventions ``bench.py`` parses from the TPU
     runtime (``process_name``/``thread_name`` metadata + ``X`` duration
-    events), so one viewer serves both.  An empty event list is the
-    disarmed/idle steady state.
+    events), so one viewer serves both.  When the profiling layer is
+    armed its device-clocked dispatch events ride along as a second
+    process track (pid 2, one thread per engine tier).  An empty event
+    list is the disarmed/idle steady state.
     """
     with _lock:
         events = list(_events)
@@ -658,7 +774,478 @@ def chrome_trace() -> dict:
                 "args": {"name": f"thread-{ident}"},
             }
         )
-    return {"displayTimeUnit": "ms", "traceEvents": meta + events}
+    all_events = meta + events
+    from sketches_tpu import profiling as _profiling
+
+    if _profiling._ACTIVE:
+        all_events = all_events + _profiling.chrome_events()
+    return {"displayTimeUnit": "ms", "traceEvents": all_events}
+
+
+# ---------------------------------------------------------------------------
+# Snapshot merge algebra (the paper's mergeability, applied to ourselves)
+# ---------------------------------------------------------------------------
+
+
+def _series_name(rendered: str) -> str:
+    """The metric base name of a rendered series key (labels stripped)."""
+    return rendered.split("{", 1)[0]
+
+
+def _gauge_policy(rendered: str) -> str:
+    m = METRICS.get(_series_name(rendered))
+    return m.merge if m is not None and m.kind == "gauge" else "max"
+
+
+def _merge_hist_summaries(summaries: List[dict], rel_acc: float) -> dict:
+    """Fold N histogram summaries into one by DDSketch bin addition.
+
+    Same-key bin mass adds (exactly ``DDSketch.merge`` on equal-gamma
+    sketches), so the merged quantiles carry the single-process alpha
+    contract; count/sum/min/max fold exactly.  Raises
+    ``SketchValueError`` when a summary has no embedded bin state (a
+    pre-r11 snapshot cannot be merged, only read).
+    """
+    pos: Dict[str, float] = {}
+    neg: Dict[str, float] = {}
+    zero = 0.0
+    total_sum = 0.0
+    mn, mx = math.inf, -math.inf
+    for sm in summaries:
+        st = sm.get("state")
+        if not isinstance(st, dict):
+            _raise_value_error(
+                "snapshot histogram carries no embedded bin state (pre-r11"
+                " format); re-export the snapshot with this version to merge"
+            )
+        for out_bins, in_bins in ((pos, st.get("pos", {})),
+                                  (neg, st.get("neg", {}))):
+            for k, v in in_bins.items():
+                out_bins[k] = out_bins.get(k, 0.0) + float(v)
+        zero += float(st.get("zero_count", 0.0))
+        total_sum += float(sm.get("sum", 0.0))
+        if sm.get("min") is not None:
+            mn = min(mn, float(sm["min"]))
+        if sm.get("max") is not None:
+            mx = max(mx, float(sm["max"]))
+    state = {"zero_count": zero, "pos": pos, "neg": neg}
+    sk = _sketch_from_state(state, rel_acc)
+    out = {
+        "count": sk.count,
+        "sum": total_sum,
+        "min": None if math.isinf(mn) else mn,
+        "max": None if math.isinf(mx) else mx,
+        "relative_accuracy": rel_acc,
+    }
+    for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
+                     (0.999, "p999")):
+        out[label] = sk.get_quantile_value(q)
+    out["state"] = state
+    return out
+
+
+def _merge_health(healths: List[dict]) -> dict:
+    """Fold resilience ledgers: counters sum, downgrade events
+    concatenate (ring-bounded, overflow counted), conflicting tier
+    entries join as ``"a|b"`` rather than silently picking one."""
+    tiers: Dict[str, str] = {}
+    counters: Dict[str, float] = {}
+    downgrades: List[dict] = []
+    dropped = 0.0
+    for h in healths:
+        if not isinstance(h, dict):
+            continue
+        for k, v in (h.get("tiers") or {}).items():
+            if k in tiers and v not in tiers[k].split("|"):
+                tiers[k] = tiers[k] + "|" + str(v)
+            elif k not in tiers:
+                tiers[k] = str(v)
+        for k, v in (h.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + float(v)
+        downgrades.extend(h.get("downgrades") or [])
+        dropped += float(h.get("downgrades_dropped", 0))
+    if len(downgrades) > _MAX_EVENTS:
+        dropped += len(downgrades) - _MAX_EVENTS
+        downgrades = downgrades[:_MAX_EVENTS]
+    return {
+        "tiers": tiers,
+        "counters": counters,
+        "downgrades": downgrades,
+        "downgrades_dropped": dropped,
+    }
+
+
+def _merge_profiling(profs: List[dict]) -> dict:
+    """Fold profiling attribution sections: measured calls/time sum,
+    min/max fold; the roofline/peaks tables (static per build) come from
+    the first operand carrying them.  Fleet-wide device-time
+    *percentiles* live in the ``profiling.device_s`` histogram, which
+    merges with full sketch fidelity."""
+    measured: Dict[str, dict] = {}
+    dropped = 0.0
+    for p in profs:
+        for k, row in (p.get("measured") or {}).items():
+            agg = measured.get(k)
+            if agg is None:
+                agg = measured[k] = {
+                    "phase": row.get("phase"),
+                    "tier": row.get("tier"),
+                    "calls": 0.0,
+                    "total_s": 0.0,
+                    "min_s": math.inf,
+                    "max_s": -math.inf,
+                }
+            agg["calls"] += float(row.get("calls", 0))
+            agg["total_s"] += float(row.get("total_s", 0.0))
+            if row.get("min_s") is not None:
+                agg["min_s"] = min(agg["min_s"], float(row["min_s"]))
+            if row.get("max_s") is not None:
+                agg["max_s"] = max(agg["max_s"], float(row["max_s"]))
+        dropped += float(p.get("events_dropped", 0))
+    for agg in measured.values():
+        agg["mean_s"] = (
+            agg["total_s"] / agg["calls"] if agg["calls"] else None
+        )
+        if math.isinf(agg["min_s"]):
+            agg["min_s"] = None
+        if math.isinf(agg["max_s"]):
+            agg["max_s"] = None
+    first = next((p for p in profs if p.get("roofline")), {})
+    return {
+        "measured": measured,
+        "roofline": first.get("roofline", {}),
+        "attribution": first.get("attribution", []),
+        "peaks": first.get("peaks", {}),
+        "events_dropped": dropped,
+    }
+
+
+def merge_snapshots(*snaps: dict) -> dict:
+    """Fold N :func:`snapshot` documents into one fleet-wide snapshot.
+
+    Counters fold by sum, gauges by their declared ``merge`` policy
+    (``max`` for names this process has not declared), histograms by
+    DDSketch bin addition -- so the merged p50/p99 carry the same
+    ``HISTOGRAM_REL_ACC`` relative-error guarantee as any single
+    process's, which is the paper's mergeability property applied to
+    the library's own telemetry.  The fold is associative and
+    commutative (bin addition is), so shard trees of any shape agree.
+
+    Raises ``SketchValueError`` for zero operands, mismatched histogram
+    relative accuracies, or histogram summaries without embedded bin
+    state (pre-r11 snapshots).  ``merged_from`` counts the leaf
+    snapshots folded in (merged operands contribute their own count).
+    """
+    if not snaps:
+        _raise_value_error("merge_snapshots needs at least one snapshot")
+    ras = {
+        float(s.get("histogram_relative_accuracy", HISTOGRAM_REL_ACC))
+        for s in snaps
+    }
+    if len(ras) != 1:
+        _raise_value_error(
+            "cannot merge snapshots with different histogram relative"
+            f" accuracies {sorted(ras)}: the merged quantiles would carry"
+            " no single alpha contract"
+        )
+    rel_acc = ras.pop()
+
+    counters: Dict[str, float] = {}
+    for s in snaps:
+        for k, v in (s.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + float(v)
+
+    gauges: Dict[str, float] = {}
+    for s in snaps:
+        for k, v in (s.get("gauges") or {}).items():
+            v = float(v)
+            if k not in gauges:
+                gauges[k] = v
+                continue
+            policy = _gauge_policy(k)
+            if policy == "sum":
+                gauges[k] += v
+            elif policy == "min":
+                gauges[k] = min(gauges[k], v)
+            else:
+                gauges[k] = max(gauges[k], v)
+
+    by_series: Dict[str, List[dict]] = {}
+    for s in snaps:
+        for k, sm in (s.get("histograms") or {}).items():
+            by_series.setdefault(k, []).append(sm)
+    hists = {
+        k: _merge_hist_summaries(sms, rel_acc)
+        for k, sms in by_series.items()
+    }
+
+    spans = {
+        "n_events": sum(
+            int((s.get("spans") or {}).get("n_events", 0)) for s in snaps
+        ),
+        "dropped": sum(
+            int((s.get("spans") or {}).get("dropped", 0)) for s in snaps
+        ),
+    }
+
+    out = {
+        "enabled": any(bool(s.get("enabled")) for s in snaps),
+        "histogram_relative_accuracy": rel_acc,
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+        "spans": spans,
+        "resilience": _merge_health(
+            [s.get("resilience") for s in snaps if s.get("resilience")]
+        ),
+        "merged_from": sum(int(s.get("merged_from", 1)) for s in snaps),
+    }
+    profs = [s["profiling"] for s in snaps if isinstance(s.get("profiling"), dict)]
+    if profs:
+        out["profiling"] = _merge_profiling(profs)
+    accs = [s["accuracy"] for s in snaps if isinstance(s.get("accuracy"), dict)]
+    if accs:
+        out["accuracy"] = {
+            "watched": sum(int(a.get("watched", 0)) for a in accs),
+            "audits": sum(int(a.get("audits", 0)) for a in accs),
+            "violations": sum(int(a.get("violations", 0)) for a in accs),
+            "reports": sum(int(a.get("reports", 0)) for a in accs),
+            "reports_dropped": sum(
+                int(a.get("reports_dropped", 0)) for a in accs
+            ),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SLO gate
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SLO:
+    """One declared service-level objective over the metric inventory.
+
+    ``kind="latency"``: ``metric`` names a histogram; the bad fraction
+    is the recorded mass above ``target_s`` (computed from the embedded
+    sketch bins, so it carries the alpha contract; falls back to a
+    p99-vs-target check on stateless summaries).  ``kind="ratio"``:
+    ``metric``/``total`` name counters; the bad fraction is their
+    ratio.  ``budget`` is the allowed bad fraction over ``window``;
+    the **burn rate** is ``bad_fraction / budget`` and the SLO is
+    burning when it exceeds ``burn_threshold``.  A metric absent from
+    the snapshot (or with zero mass) is *skipped*, never a pass.
+    """
+
+    name: str
+    kind: str  # "latency" | "ratio"
+    metric: str
+    total: str = ""
+    target_s: float = 0.0
+    budget: float = 0.01
+    burn_threshold: float = 1.0
+    window: str = "1h"
+    doc: str = ""
+
+
+#: The declared SLO inventory ``--check-slo`` evaluates: the acceptance
+#: instrument for the serving tier (ROADMAP #1).  Budgets are sized for
+#: clean production-shaped workloads (the fleet_dashboard example, the
+#: CI observability job), with headroom for host-timed jitter but not
+#: for drift: a latency regression, a quarantine storm, or an alpha-
+#: contract break burns through them.
+SLOS: Tuple[SLO, ...] = (
+    SLO(
+        "query-latency", "latency", "query_s", target_s=0.25, budget=0.05,
+        window="1h",
+        doc="<=5% of (warm) query dispatches above 250 ms.",
+    ),
+    SLO(
+        "ingest-latency", "latency", "ingest_s", target_s=1.0, budget=0.05,
+        window="1h",
+        doc="<=5% of facade ingest dispatches above 1 s.",
+    ),
+    SLO(
+        "wire-decode-latency", "latency", "wire.decode_s", target_s=5.0,
+        budget=0.25, window="1h",
+        doc="<=25% of bulk wire decodes above 5 s (a bulk decode covers"
+        " up to 100k+ blobs; the ROADMAP letter targets 1 s at 100k).",
+    ),
+    SLO(
+        "wire-quarantine", "ratio", "wire.blobs_quarantined",
+        total="wire.blobs_decoded", budget=0.001, window="1h",
+        doc="<=0.1% of decoded blobs quarantined: more means corrupt"
+        " producers or wire drift, not isolated bit rot.",
+    ),
+    SLO(
+        "accuracy-contract", "ratio", "accuracy.violations",
+        total="accuracy.audits", budget=0.01, window="1h",
+        doc="<=1% of shadow audits may breach the alpha contract"
+        " (UDDSketch's silent-collapse failure mode, gated).",
+    ),
+)
+
+
+def check_slo(
+    snap: dict, slos: Optional[Tuple[SLO, ...]] = None
+) -> Tuple[List[str], int, int]:
+    """Evaluate :data:`SLOS` against a snapshot -> (report lines,
+    n_burning, n_evaluated).
+
+    Works on single-process and merged snapshots alike.  SLOs whose
+    metrics are absent (or have zero total mass) are skipped -- callers
+    must treat ``n_evaluated == 0`` as a failure in its own right (the
+    ``check_bench`` convention: wrong files beat a silent pass).
+    """
+    if slos is None:
+        slos = SLOS
+    rel_acc = float(snap.get("histogram_relative_accuracy",
+                             HISTOGRAM_REL_ACC))
+    hists = snap.get("histograms") or {}
+    counters = snap.get("counters") or {}
+    lines: List[str] = []
+    burning = evaluated = 0
+    for slo in slos:
+        if slo.kind == "ratio":
+            bad = sum(
+                float(v) for k, v in counters.items()
+                if _series_name(k) == slo.metric
+            )
+            total = sum(
+                float(v) for k, v in counters.items()
+                if _series_name(k) == slo.total
+            )
+            if total <= 0:
+                lines.append(f"  skipped  {slo.name}: no {slo.total} mass")
+                continue
+            frac = bad / total
+            detail = f"bad {bad:g}/{total:g}"
+        else:
+            series = [
+                sm for k, sm in hists.items()
+                if _series_name(k) == slo.metric
+            ]
+            total = sum(float(sm.get("count", 0.0)) for sm in series)
+            if total <= 0:
+                lines.append(
+                    f"  skipped  {slo.name}: no {slo.metric} observations"
+                )
+                continue
+            states = [
+                sm["state"] for sm in series
+                if isinstance(sm.get("state"), dict)
+            ]
+            if len(states) == len(series):
+                from sketches_tpu.mapping import LogarithmicMapping
+
+                mapping = LogarithmicMapping(rel_acc)
+                bad = 0.0
+                for st in states:
+                    for key, cnt in st.get("pos", {}).items():
+                        if mapping.value(int(key)) > slo.target_s:
+                            bad += float(cnt)
+                frac = bad / total
+                detail = f"bad {bad:g}/{total:g} above {slo.target_s:g}s"
+            else:
+                # Stateless (pre-r11) summary: p99 vs target is the best
+                # available proxy -- burning iff p99 blows the target.
+                p99 = max(
+                    (float(sm["p99"]) for sm in series
+                     if sm.get("p99") is not None),
+                    default=0.0,
+                )
+                frac = slo.budget * (p99 / slo.target_s) if slo.target_s else 0.0
+                detail = f"p99 {p99:g}s vs target {slo.target_s:g}s (no state)"
+        if slo.budget > 0:
+            burn = frac / slo.budget
+        else:
+            burn = math.inf if frac > 0 else 0.0
+        evaluated += 1
+        bad_slo = burn > slo.burn_threshold
+        if bad_slo:
+            burning += 1
+        verdict = "BURNING" if bad_slo else "ok"
+        lines.append(
+            f"{verdict:>9}  {slo.name}: burn x{burn:.2f} ({detail},"
+            f" budget {slo.budget:.2%}/{slo.window},"
+            f" threshold x{slo.burn_threshold:g})"
+        )
+    return lines, burning, evaluated
+
+
+# ---------------------------------------------------------------------------
+# Bench-derived snapshots (the checked-in SLO-gate fixture)
+# ---------------------------------------------------------------------------
+
+#: Bench summary latency fields -> (histogram metric, labels): the
+#: measured numbers a ``--bench-snapshot`` replays into sketch-backed
+#: histograms, producing a real mergeable snapshot from a checked-in
+#: BENCH document (so the SLO gate has a stable, reviewable fixture).
+_BENCH_OBSERVE: Tuple[Tuple[str, str, Tuple[Tuple[str, str], ...]], ...] = (
+    ("configs.c0_host_python.query_s", "query_s",
+     (("component", "bench"), ("tier", "host"))),
+    ("configs.c1_10k_streams.query_p50_s", "query_s",
+     (("component", "bench"), ("tier", "c1"))),
+    ("configs.c1_10k_streams.query_p99_s", "query_s",
+     (("component", "bench"), ("tier", "c1"))),
+    ("configs.c2_c4_1m_streams_cubic_collapsing.query_p50_s", "query_s",
+     (("component", "bench"), ("tier", "c2"))),
+    ("configs.c2s_shard_query_131k.worst_mixed_sign.query_sustained_s",
+     "query_s", (("component", "bench"), ("tier", "shard131k"))),
+    ("configs.c2s_shard_query_131k.wide_window.query_sustained_s",
+     "query_s", (("component", "bench"), ("tier", "shard131k"))),
+    ("configs.c2s_shard_query_131k.mid_occupancy.query_sustained_s",
+     "query_s", (("component", "bench"), ("tier", "shard131k"))),
+    ("configs.c2s_shard_query_131k.tight_telemetry.query_sustained_s",
+     "query_s", (("component", "bench"), ("tier", "shard131k"))),
+    ("configs.c2s_shard_query_131k.merge_per_shard_s", "merge_s",
+     (("component", "bench"),)),
+    ("configs.c3_distributed.cpu_mesh_8dev.psum_merge.merge_s",
+     "distributed.fold_s", ()),
+    ("configs.serde_bulk.to_bytes_s", "wire.encode_s", ()),
+    ("configs.serde_bulk.from_bytes_s", "wire.decode_s", ()),
+)
+
+
+def snapshot_from_bench(bench_doc: dict) -> dict:
+    """Derive a mergeable snapshot from a ``bench.py`` summary document.
+
+    Each known latency field (:data:`_BENCH_OBSERVE`) is observed into
+    the matching sketch-backed histogram, so the result is a REAL
+    snapshot -- mergeable, SLO-checkable -- whose distributions are the
+    bench's measured numbers.  Raises ``SketchValueError`` when the
+    document carries none of the known fields (wrong file).
+    """
+    hists: Dict[_Key, _Hist] = {}
+    observed = 0
+    for path, metric, labels in _BENCH_OBSERVE:
+        v = _lookup(bench_doc, path)
+        if v is None:
+            continue
+        k = _key(metric, dict(labels))
+        h = hists.get(k)
+        if h is None:
+            h = hists[k] = _Hist()
+        h.add(float(v))
+        observed += 1
+    if not observed:
+        _raise_value_error(
+            "bench document carries no known latency field; expected a"
+            " bench.py summary (e.g. BENCH_local_r05.json)"
+        )
+    return {
+        "enabled": False,
+        "histogram_relative_accuracy": HISTOGRAM_REL_ACC,
+        "counters": {},
+        "gauges": {},
+        "histograms": {_render_key(k): h.summary() for k, h in hists.items()},
+        "spans": {"n_events": 0, "dropped": 0},
+        "resilience": {
+            "tiers": {}, "counters": {}, "downgrades": [],
+            "downgrades_dropped": 0,
+        },
+        "derived_from": "bench",
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -742,18 +1329,34 @@ def check_bench(
     return lines, regressed, compared
 
 
-def main(argv: Optional[List[str]] = None) -> int:
-    """CLI entry point: the bench regression gate (and snapshot dumps).
+def _load_json(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
 
-    Exit codes: 0 clean, 1 on any regressed metric, 2 when nothing was
-    comparable (wrong files must not pass silently).
+
+def _dump_json(doc: dict, path: Optional[str]) -> None:
+    text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    if path:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        print(text, end="")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI entry point: bench regression gate, snapshot merge, SLO gate,
+    bench-derived snapshots, and process snapshot dumps.
+
+    Exit codes: 0 clean, 1 on any regressed metric / burning SLO, 2 when
+    nothing was comparable or evaluable (wrong files must not pass
+    silently).
     """
     import argparse
 
     parser = argparse.ArgumentParser(
         prog="python -m sketches_tpu.telemetry",
-        description="telemetry utilities: bench regression gate,"
-        " snapshot dumps",
+        description="telemetry utilities: bench regression gate, snapshot"
+        " merge (fleet aggregation), SLO gate, snapshot dumps",
     )
     parser.add_argument(
         "--check-bench",
@@ -769,6 +1372,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="override every per-metric tolerance with one fraction",
     )
     parser.add_argument(
+        "--merge",
+        nargs="+",
+        metavar="SNAP",
+        default=None,
+        help="fold N snapshot JSONs (per-shard / per-job artifacts) into"
+        " one fleet-wide snapshot; counters sum, gauges fold by declared"
+        " policy, histograms merge as DDSketches (alpha preserved)",
+    )
+    parser.add_argument(
+        "--check-slo",
+        metavar="SNAPSHOT",
+        default=None,
+        help="evaluate the declared SLO inventory (telemetry.SLOS) against"
+        " a snapshot JSON; exit 1 on any burning SLO, 2 if nothing was"
+        " evaluable",
+    )
+    parser.add_argument(
+        "--bench-snapshot",
+        nargs=2,
+        metavar=("BENCH", "OUT"),
+        default=None,
+        help="derive a mergeable snapshot from a bench.py summary's"
+        " measured latencies and write it to OUT",
+    )
+    parser.add_argument(
+        "--out",
+        metavar="PATH",
+        default=None,
+        help="where --merge writes the merged snapshot (stdout otherwise)",
+    )
+    parser.add_argument(
         "--snapshot",
         metavar="PATH",
         default=None,
@@ -781,25 +1415,56 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="write the current process's Prometheus exposition to PATH",
     )
     args = parser.parse_args(argv)
+    acted = False
 
     if args.snapshot:
-        with open(args.snapshot, "w", encoding="utf-8") as f:
-            json.dump(snapshot(), f, indent=1, sort_keys=True)
-            f.write("\n")
+        acted = True
+        _dump_json(snapshot(), args.snapshot)
     if args.prometheus:
+        acted = True
         with open(args.prometheus, "w", encoding="utf-8") as f:
             f.write(prometheus_text())
+
+    if args.bench_snapshot:
+        acted = True
+        bench_path, out_path = args.bench_snapshot
+        _dump_json(snapshot_from_bench(_load_json(bench_path)), out_path)
+        print(f"bench-snapshot: {bench_path} -> {out_path}")
+
+    if args.merge:
+        acted = True
+        merged = merge_snapshots(*[_load_json(p) for p in args.merge])
+        _dump_json(merged, args.out)
+        print(
+            f"merge: folded {merged['merged_from']} snapshot(s)"
+            + (f" -> {args.out}" if args.out else "")
+        )
+
+    if args.check_slo:
+        lines, burning, evaluated = check_slo(_load_json(args.check_slo))
+        for line in lines:
+            print(line)
+        if evaluated == 0:
+            print(
+                "check-slo: no SLO was evaluable against this snapshot"
+                " (wrong file?)"
+            )
+            return 2
+        if burning:
+            print(f"check-slo: {burning}/{evaluated} SLO(s) BURNING")
+            return 1
+        print(f"check-slo: {evaluated} SLO(s) within budget")
+        return 0
+
     if not args.check_bench:
-        if args.snapshot or args.prometheus:
+        if acted:
             return 0
         parser.print_usage()
         return 2
 
     old_path, new_path = args.check_bench
-    with open(old_path, "r", encoding="utf-8") as f:
-        old_doc = json.load(f)
-    with open(new_path, "r", encoding="utf-8") as f:
-        new_doc = json.load(f)
+    old_doc = _load_json(old_path)
+    new_doc = _load_json(new_path)
     lines, regressed, compared = check_bench(
         old_doc, new_doc, tolerance=args.tolerance
     )

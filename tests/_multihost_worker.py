@@ -1,12 +1,20 @@
 """Worker process for the 2-process multi-host smoke test.
 
 Run as: python _multihost_worker.py <coordinator_port> <process_id> <n_procs>
+        [snapshot_dir]
 
 Each process exposes 4 virtual CPU devices; ``jax.distributed.initialize``
 joins them into one 8-device job, ``make_global_mesh`` lays the job-wide
 mesh, and the DDSketch psum-merge collective folds per-device partial
 histograms across the process (DCN-analog) boundary — the multi-host path
 SURVEY.md section 5 (comm-backend row) requires.
+
+When ``snapshot_dir`` is given, each worker ARMS the telemetry layer,
+records its ingest/query work plus a deterministic per-process set of
+``query_s`` observations, and writes its snapshot to
+``snapshot_dir/snap<pid>.json`` — the per-shard artifacts the parent
+test folds with ``telemetry.merge_snapshots`` (the fleet-aggregation
+path a real multi-host job's per-host snapshots take).
 """
 import os
 import sys
@@ -20,6 +28,7 @@ LOCAL_DEVICES = 4
 
 def main() -> None:
     port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    snapshot_dir = sys.argv[4] if len(sys.argv) > 4 else None
     os.environ.update(cpu_mesh_env(LOCAL_DEVICES, os.environ))
     import jax
 
@@ -49,6 +58,12 @@ def main() -> None:
 
     from sketches_tpu.batched import SketchSpec, add, init, quantile
     from sketches_tpu.parallel import make_global_mesh, psum_merge, shard_map
+
+    if snapshot_dir:
+        from sketches_tpu import telemetry
+
+        telemetry.enable()
+        telemetry.reset()
 
     spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
     n_streams, chunk = 4, 64
@@ -92,6 +107,27 @@ def main() -> None:
             assert abs(got[i, j] - exact) <= 0.0101 * abs(exact) + 1e-6, (
                 i, q, got[i, j], exact,
             )
+    if snapshot_dir:
+        import json
+
+        from sketches_tpu import telemetry
+        from sketches_tpu.batched import BatchedDDSketch
+
+        # A facade-tier workload so the instrumented seams record, plus
+        # a deterministic per-process latency series: worker p observes
+        # durations 10**p * (1..32) ms, so the parent can check the
+        # MERGED histogram's quantiles against the exact union.
+        facade = BatchedDDSketch(n_streams, spec=spec)
+        facade.add(all_vals[pid * LOCAL_DEVICES])
+        facade.get_quantile_values([0.5, 0.99])
+        for k in range(1, 33):
+            telemetry.observe(
+                "query_s", k * 1e-3 * (10.0 ** pid), component="mh"
+            )
+        snap_path = os.path.join(snapshot_dir, f"snap{pid}.json")
+        with open(snap_path, "w", encoding="utf-8") as f:
+            json.dump(telemetry.snapshot(), f, indent=1, sort_keys=True)
+            f.write("\n")
     jax.distributed.shutdown()
     print(f"MULTIHOST_OK pid={pid}")
 

@@ -605,7 +605,9 @@ class TestLiveTree:
         assert all(e["ok"] for e in report["entries"].values())
 
     def test_package_version_bumped(self):
-        assert sketches_tpu.__version__ >= "0.7.0"
+        # Tuple compare, not string compare: "0.10.0" < "0.7.0" as text.
+        version = tuple(int(p) for p in sketches_tpu.__version__.split("."))
+        assert version >= (0, 7, 0)
 
 
 class TestCli:

@@ -19,6 +19,7 @@ _EXAMPLES = os.path.join(
         "heterogeneous_fleet.py",
         "wire_interop.py",
         "chaos_drill.py",
+        "fleet_dashboard.py",
     ],
 )
 def test_example_runs_clean(script):

@@ -1,0 +1,513 @@
+"""Fleet observability acceptance suite (ISSUE r11).
+
+Proves the contracts the fleet layer is sold on:
+
+(a) snapshot merge algebra: counters fold associatively/commutatively,
+    gauges follow their declared policies, and the MERGED histogram
+    quantiles agree with a single-process run over the union stream
+    within the alpha contract (the paper's mergeability, applied to
+    the library's own telemetry);
+(b) the SLO gate's exit-code contract: 0 on the checked-in
+    bench-derived snapshot, 1 on a doctored burning one, 2 when
+    nothing is evaluable;
+(c) device-time profiling: disarmed seams never call into the layer,
+    armed runs produce a measured-vs-roofline attribution table that
+    rides the snapshot and the chrome trace's device track;
+(d) the accuracy shadow audit: healthy streams audit clean,
+    contract-breaking answers produce violations + DriftReports, and
+    the reservoir is deterministic;
+(e) satellites: the spans.dropped counter on ring wrap, and the chaos
+    verdict embedding the telemetry snapshot when armed.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from sketches_tpu import accuracy, faults, profiling, resilience, telemetry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.resilience import SketchValueError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers():
+    """Every test starts with telemetry/profiling/accuracy disarmed and
+    empty, and restores the process's arming state afterwards."""
+    was_t, was_p, was_a = (
+        telemetry.enabled(), profiling.enabled(), accuracy.enabled()
+    )
+    telemetry.disable()
+    telemetry.reset()
+    profiling.disable()
+    profiling.reset()
+    accuracy.disable()
+    accuracy.reset()
+    faults.disarm()
+    resilience.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+    telemetry.reset()
+    profiling.reset()
+    accuracy.reset()
+    telemetry.enable(was_t)
+    profiling.enable(was_p)
+    accuracy.enable(was_a)
+
+
+def _snapshot_with(durations, counters=(), gauges=()):
+    """Build one real snapshot: arm, record, snapshot, reset."""
+    telemetry.enable()
+    telemetry.reset()
+    for d in durations:
+        telemetry.observe("query_s", float(d), component="fleet")
+    for name, n in counters:
+        telemetry.counter_inc(name, n)
+    for name, v in gauges:
+        telemetry.gauge_set(name, v)
+    snap = telemetry.snapshot()
+    telemetry.reset()
+    telemetry.disable()
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# (a) Merge algebra
+# ---------------------------------------------------------------------------
+
+
+class TestMergeAlgebra:
+    def test_counters_associative_and_commutative(self):
+        rng = np.random.RandomState(7)
+        snaps = [
+            _snapshot_with(
+                rng.lognormal(-5, 1, 50),
+                counters=[("wire.blobs_decoded", float(rng.randint(1, 100)))],
+            )
+            for _ in range(3)
+        ]
+        a, b, c = snaps
+        left = telemetry.merge_snapshots(telemetry.merge_snapshots(a, b), c)
+        right = telemetry.merge_snapshots(a, telemetry.merge_snapshots(b, c))
+        flat = telemetry.merge_snapshots(a, b, c)
+        for m in (left, right, flat):
+            assert m["merged_from"] == 3
+        for key in flat["counters"]:
+            assert left["counters"][key] == pytest.approx(
+                right["counters"][key]
+            )
+            assert flat["counters"][key] == pytest.approx(
+                left["counters"][key]
+            )
+        ab, ba = (
+            telemetry.merge_snapshots(a, b),
+            telemetry.merge_snapshots(b, a),
+        )
+        assert ab["counters"] == ba["counters"]
+        # Histogram quantiles agree regardless of fold shape: same bins.
+        series = next(iter(flat["histograms"]))
+        for m in (left, right, ba):
+            assert m["histograms"][series]["p99"] == pytest.approx(
+                flat["histograms"][series]["p99"]
+            )
+
+    def test_merged_quantiles_match_single_process_within_alpha(self):
+        rng = np.random.RandomState(3)
+        union = rng.lognormal(-6, 1.2, 900)
+        shards = [union[i::3] for i in range(3)]
+        merged = telemetry.merge_snapshots(
+            *[_snapshot_with(s) for s in shards]
+        )
+        single = _snapshot_with(union)
+        series = 'query_s{component="fleet"}'
+        m, s = merged["histograms"][series], single["histograms"][series]
+        assert m["count"] == pytest.approx(union.size)
+        assert m["sum"] == pytest.approx(s["sum"])
+        assert m["min"] == pytest.approx(s["min"])
+        assert m["max"] == pytest.approx(s["max"])
+        alpha = merged["histogram_relative_accuracy"]
+        for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            exact = np.quantile(union, q, method="lower")
+            # Merged vs single-process: identical bins -> identical values.
+            assert m[label] == pytest.approx(s[label])
+            # And both honor the alpha contract against the exact stream.
+            assert abs(m[label] - exact) <= 2 * alpha * abs(exact) + 1e-12
+
+    def test_gauge_policies(self):
+        telemetry.declare("fleet.qps", "gauge", "test", merge="sum")
+        telemetry.declare("fleet.oldest", "gauge", "test", merge="min")
+        snaps = []
+        for v in (3.0, 5.0):
+            snaps.append(
+                _snapshot_with(
+                    [],
+                    gauges=[
+                        ("fleet.qps", v),
+                        ("fleet.oldest", v),
+                        ("checkpoint.bytes", v),  # declared merge="max"
+                    ],
+                )
+            )
+        m = telemetry.merge_snapshots(*snaps)
+        assert m["gauges"]["fleet.qps"] == 8.0
+        assert m["gauges"]["fleet.oldest"] == 3.0
+        assert m["gauges"]["checkpoint.bytes"] == 5.0
+
+    def test_mismatched_alpha_refused(self):
+        a = _snapshot_with([0.01])
+        b = _snapshot_with([0.01])
+        b["histogram_relative_accuracy"] = 0.05
+        with pytest.raises(SketchValueError):
+            telemetry.merge_snapshots(a, b)
+
+    def test_stateless_histogram_refused(self):
+        a = _snapshot_with([0.01])
+        for sm in a["histograms"].values():
+            sm.pop("state")
+        with pytest.raises(SketchValueError):
+            telemetry.merge_snapshots(a, a)
+
+    def test_no_operands_refused(self):
+        with pytest.raises(SketchValueError):
+            telemetry.merge_snapshots()
+
+    def test_spans_and_resilience_fold(self):
+        telemetry.enable()
+        telemetry.reset()
+        with telemetry.span("query_s", component="fleet"):
+            pass
+        resilience.record_downgrade("t.query", "tiles", "windowed", "x")
+        snap = telemetry.snapshot()
+        telemetry.reset()
+        resilience.reset()
+        m = telemetry.merge_snapshots(snap, snap)
+        assert m["spans"]["n_events"] == 2 * snap["spans"]["n_events"]
+        assert len(m["resilience"]["downgrades"]) == 2
+        assert m["resilience"]["counters"]["downgrades"] == 2
+        # Conflicting tier entries join instead of silently picking one.
+        other = json.loads(json.dumps(snap))
+        other["resilience"]["tiers"]["t.query"] = "xla"
+        m2 = telemetry.merge_snapshots(snap, other)
+        assert set(m2["resilience"]["tiers"]["t.query"].split("|")) == {
+            "windowed", "xla",
+        }
+
+    def test_merge_snapshot_round_trips_through_json(self, tmp_path):
+        snaps = [_snapshot_with([0.001 * k]) for k in range(1, 4)]
+        paths = []
+        for i, s in enumerate(snaps):
+            p = tmp_path / f"s{i}.json"
+            p.write_text(json.dumps(s))
+            paths.append(str(p))
+        out = tmp_path / "merged.json"
+        rc = telemetry.main(["--merge", *paths, "--out", str(out)])
+        assert rc == 0
+        merged = json.loads(out.read_text())
+        assert merged["merged_from"] == 3
+        # Merged snapshots stay mergeable (state re-embedded).
+        again = telemetry.merge_snapshots(merged, snaps[0])
+        assert again["merged_from"] == 4
+
+
+# ---------------------------------------------------------------------------
+# (b) SLO gate
+# ---------------------------------------------------------------------------
+
+
+class TestSLOGate:
+    def test_clean_latencies_pass(self):
+        snap = _snapshot_with(
+            [0.001] * 100,
+            counters=[
+                ("wire.blobs_decoded", 1000.0),
+                ("wire.blobs_quarantined", 0.0),
+            ],
+        )
+        lines, burning, evaluated = telemetry.check_slo(snap)
+        assert burning == 0
+        assert evaluated >= 2
+
+    def test_burning_latency_detected(self):
+        # 10% of queries above the 250 ms target vs a 5% budget.
+        snap = _snapshot_with([0.001] * 90 + [0.9] * 10)
+        lines, burning, evaluated = telemetry.check_slo(snap)
+        assert burning == 1
+        assert any("BURNING" in ln and "query-latency" in ln for ln in lines)
+
+    def test_burning_ratio_detected(self):
+        snap = _snapshot_with(
+            [],
+            counters=[
+                ("wire.blobs_decoded", 1000.0),
+                ("wire.blobs_quarantined", 50.0),
+            ],
+        )
+        lines, burning, _ = telemetry.check_slo(snap)
+        assert burning == 1
+        assert any("wire-quarantine" in ln and "BURNING" in ln for ln in lines)
+
+    def test_empty_snapshot_is_not_a_pass(self, tmp_path):
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps({"counters": {}, "histograms": {}}))
+        assert telemetry.main(["--check-slo", str(p)]) == 2
+
+    def test_checked_in_bench_snapshot_passes(self):
+        path = os.path.join(REPO_ROOT, "SNAPSHOT_bench_r05.json")
+        assert telemetry.main(["--check-slo", path]) == 0
+
+    def test_checked_in_snapshot_matches_regeneration(self):
+        with open(os.path.join(REPO_ROOT, "BENCH_local_r05.json")) as f:
+            bench = json.load(f)
+        with open(os.path.join(REPO_ROOT, "SNAPSHOT_bench_r05.json")) as f:
+            checked_in = json.load(f)
+        assert telemetry.snapshot_from_bench(bench) == checked_in
+
+    def test_doctored_bench_snapshot_burns(self, tmp_path):
+        with open(os.path.join(REPO_ROOT, "BENCH_local_r05.json")) as f:
+            bench = json.load(f)
+        bench["configs"]["serde_bulk"]["from_bytes_s"] = 500.0
+        snap = telemetry.snapshot_from_bench(bench)
+        p = tmp_path / "burning.json"
+        p.write_text(json.dumps(snap))
+        assert telemetry.main(["--check-slo", str(p)]) == 1
+
+    def test_bench_snapshot_cli(self, tmp_path):
+        out = tmp_path / "snap.json"
+        rc = telemetry.main([
+            "--bench-snapshot",
+            os.path.join(REPO_ROOT, "BENCH_local_r05.json"),
+            str(out),
+        ])
+        assert rc == 0
+        snap = json.loads(out.read_text())
+        assert snap["histograms"]
+        # Bench-derived snapshots are real snapshots: mergeable.
+        merged = telemetry.merge_snapshots(snap, snap)
+        assert merged["merged_from"] == 2
+
+    def test_wrong_bench_doc_refused(self):
+        with pytest.raises(SketchValueError):
+            telemetry.snapshot_from_bench({"not": "a bench doc"})
+
+
+# ---------------------------------------------------------------------------
+# (c) Device-time profiling
+# ---------------------------------------------------------------------------
+
+
+def _small_workload(n=8, seed=0, batches=2):
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    sk = BatchedDDSketch(n, spec=spec)
+    rng = np.random.RandomState(seed)
+    for _ in range(batches):
+        sk.add(rng.lognormal(0, 0.5, (n, 64)).astype(np.float32))
+    sk.get_quantile_values([0.5, 0.99])
+    return spec, sk
+
+
+class TestProfiling:
+    def test_disarmed_seams_never_enter_the_layer(self, monkeypatch):
+        def bomb(*a, **k):  # pragma: no cover - firing is the failure
+            raise AssertionError("profiling.record on a disarmed seam")
+
+        monkeypatch.setattr(profiling, "record", bomb)
+        _small_workload()
+
+    def test_armed_attribution_table(self):
+        profiling.enable()
+        _, sk = _small_workload()
+        other = BatchedDDSketch(8, spec=sk.spec)
+        other.add(np.ones((8, 16), np.float32))
+        sk.merge(other)
+        att = profiling.attribution()
+        measured = att["measured"]
+        ingest = measured["ingest/xla"]
+        assert ingest["calls"] >= 2
+        assert ingest["total_s"] > 0
+        assert measured["fold/merge"]["calls"] == 1
+        assert any(row["phase"] == "query" for row in att["attribution"])
+        roof = att["roofline"]
+        assert roof["batched.add"]["flops"] > 0
+        assert roof["batched.add"]["bytes"] > 0
+        joined = [r for r in att["attribution"] if r["x_roofline"] is not None]
+        assert joined, "no measured row joined its roofline entry"
+
+    def test_profiling_rides_snapshot_trace_and_merge(self):
+        telemetry.enable()
+        profiling.enable()
+        _small_workload()
+        snap = telemetry.snapshot()
+        assert "profiling" in snap
+        assert any(
+            k.startswith("profiling.device_s") for k in snap["histograms"]
+        )
+        trace = telemetry.chrome_trace()
+        pids = {ev.get("pid") for ev in trace["traceEvents"]}
+        assert 2 in pids, "no device track in the chrome trace"
+        merged = telemetry.merge_snapshots(snap, snap)
+        m_ing = merged["profiling"]["measured"]["ingest/xla"]
+        s_ing = snap["profiling"]["measured"]["ingest/xla"]
+        assert m_ing["calls"] == 2 * s_ing["calls"]
+        assert m_ing["total_s"] == pytest.approx(2 * s_ing["total_s"])
+
+    def test_reset_clears_measurements(self):
+        profiling.enable()
+        _small_workload()
+        assert profiling.attribution()["measured"]
+        profiling.reset()
+        assert not profiling.attribution()["measured"]
+
+
+# ---------------------------------------------------------------------------
+# (d) Accuracy shadow audit
+# ---------------------------------------------------------------------------
+
+
+class TestAccuracyAudit:
+    def test_disarmed_seam_never_enters_the_layer(self, monkeypatch):
+        def bomb(*a, **k):  # pragma: no cover - firing is the failure
+            raise AssertionError("accuracy.observe_ingest on a disarmed seam")
+
+        monkeypatch.setattr(accuracy, "observe_ingest", bomb)
+        _small_workload()
+
+    def test_healthy_stream_audits_clean(self):
+        telemetry.enable()
+        accuracy.enable()
+        spec = SketchSpec(relative_accuracy=0.02, n_bins=256)
+        sk = BatchedDDSketch(4, spec=spec)
+        accuracy.watch(sk, "healthy", streams=(0, 1), interval=2)
+        rng = np.random.RandomState(11)
+        for _ in range(6):
+            sk.add(rng.lognormal(0, 0.5, (4, 128)).astype(np.float32))
+        s = accuracy.summary()
+        assert s["audits"] == 3
+        assert s["violations"] == 0
+        assert accuracy.reports() == []
+        snap = telemetry.snapshot()
+        assert snap["counters"]["accuracy.audits"] == 3.0
+        assert snap["accuracy"]["watched"] == 1
+        assert any(
+            k.startswith("accuracy.rel_err") for k in snap["gauges"]
+        )
+
+    def test_contract_breaking_answers_are_violations(self):
+        telemetry.enable()
+        accuracy.enable()
+
+        class LyingSketch:
+            """Quantile API that answers 10x the truth."""
+
+            n_streams = 1
+            spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
+
+            def get_quantile_values(self, qs):
+                return np.full((1, len(qs)), 1e6, np.float64)
+
+        liar = LyingSketch()
+        accuracy.watch(liar, "liar", streams=(0,), interval=1)
+        rng = np.random.RandomState(5)
+        accuracy.observe_ingest(liar, rng.lognormal(0, 0.5, (1, 256)))
+        s = accuracy.summary()
+        assert s["violations"] == len(accuracy.AUDIT_QS)
+        reps = accuracy.reports()
+        assert reps and all(r.kind == "rank-error" for r in reps)
+        assert all(r.rel_err > 1.0 for r in reps)
+        snap = telemetry.snapshot()
+        assert snap["counters"]["accuracy.violations"] >= 1.0
+
+    def test_collapse_drift_reported(self):
+        accuracy.enable()
+        spec = SketchSpec(relative_accuracy=0.02, n_bins=64)
+        sk = BatchedDDSketch(1, spec=spec)
+        accuracy.watch(sk, "collapsing", streams=(0,), interval=1)
+        rng = np.random.RandomState(2)
+        # First batch centers the tiny window; the second spans 12
+        # decades, so most mass clamps into the edge bins.
+        sk.add(np.full((1, 64), 1.0, np.float32))
+        sk.add(
+            (10.0 ** rng.uniform(-6, 6, (1, 256))).astype(np.float32)
+        )
+        kinds = {r.kind for r in accuracy.reports()}
+        assert "collapse-drift" in kinds
+        frac = [
+            r.collapsed_frac for r in accuracy.reports()
+            if r.kind == "collapse-drift"
+        ]
+        assert max(frac) > accuracy.COLLAPSE_DRIFT
+
+    def test_reservoir_is_deterministic_and_bounded(self):
+        from sketches_tpu.accuracy import _Reservoir
+
+        rng = np.random.RandomState(9)
+        data = rng.lognormal(0, 1, 20000)
+        r1, r2 = _Reservoir(256, seed=42), _Reservoir(256, seed=42)
+        for chunk in np.array_split(data, 7):
+            r1.extend(chunk)
+        r2.extend(data)
+        assert len(r1.buf) == 256
+        assert r1.n == data.size
+        # Same seed + same stream -> same kept set, chunking included.
+        assert r1.buf == r2.buf
+        # And the sample stays representative: its median is close.
+        med = float(np.median(r1.sorted_sample()))
+        assert abs(med - float(np.median(data))) < 0.3
+
+    def test_watch_refuses_junk(self):
+        with pytest.raises(SketchValueError):
+            accuracy.watch(object(), "junk")
+        spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+        sk = BatchedDDSketch(2, spec=spec)
+        accuracy.watch(sk, "dup")
+        with pytest.raises(SketchValueError):
+            accuracy.watch(sk, "dup")
+        with pytest.raises(SketchValueError):
+            accuracy.watch(sk, "oob", streams=(99,))
+        with pytest.raises(SketchValueError):
+            accuracy.watch(sk, "badint", interval=0)
+
+
+# ---------------------------------------------------------------------------
+# (e) Satellites
+# ---------------------------------------------------------------------------
+
+
+class TestSatellites:
+    def test_span_ring_wrap_counts_in_declared_counter(self, monkeypatch):
+        monkeypatch.setattr(telemetry, "_MAX_EVENTS", 4)
+        telemetry.enable()
+        for _ in range(10):
+            with telemetry.span("query_s", component="fleet"):
+                pass
+        snap = telemetry.snapshot()
+        assert snap["spans"]["dropped"] == 6
+        assert snap["counters"]["spans.dropped"] == 6.0
+        telemetry.reset()
+        snap2 = telemetry.snapshot()
+        assert snap2["spans"]["dropped"] == 0
+        assert "spans.dropped" not in snap2["counters"]
+
+    def test_chaos_verdict_embeds_snapshot_when_armed(self):
+        from sketches_tpu import chaos
+
+        telemetry.enable()
+        telemetry.reset()
+        verdict = chaos.run_campaign(steps=12, seed=3)
+        assert verdict["ok"], verdict["errors"]
+        emb = verdict["telemetry"]
+        assert isinstance(emb, dict)
+        assert emb["counters"].get("integrity.checks", 0) > 0
+        # The embedded snapshot is a first-class mergeable artifact.
+        merged = telemetry.merge_snapshots(emb, emb)
+        assert merged["merged_from"] == 2
+
+    def test_chaos_verdict_none_when_disarmed(self):
+        from sketches_tpu import chaos
+
+        verdict = chaos.run_campaign(steps=6, seed=4)
+        assert verdict["telemetry"] is None

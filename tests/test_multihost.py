@@ -31,7 +31,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_global_mesh_psum_merge():
+def test_two_process_global_mesh_psum_merge(tmp_path):
     try:
         port = _free_port()
     except OSError as e:  # pragma: no cover - sandboxed loopback
@@ -48,7 +48,8 @@ def test_two_process_global_mesh_psum_merge():
     env.pop("XLA_FLAGS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(port), str(pid), "2"],
+            [sys.executable, _WORKER, str(port), str(pid), "2",
+             str(tmp_path)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -88,3 +89,36 @@ def test_two_process_global_mesh_psum_merge():
         )
     assert all(p.returncode == 0 for p in procs), transcript
     assert all(f"MULTIHOST_OK pid={i}" in outs[i] for i in range(2)), transcript
+
+    # Fleet aggregation: fold the two workers' telemetry snapshot files
+    # -- the multi-host shard -> merged-artifact path.  Counters must
+    # sum exactly; the merged histogram's quantiles must agree with the
+    # exact union of the two processes' deterministic observations
+    # within the histogram's declared relative accuracy.
+    import json
+
+    import numpy as np
+
+    from sketches_tpu import telemetry
+
+    snaps = []
+    for pid in range(2):
+        with open(tmp_path / f"snap{pid}.json", encoding="utf-8") as f:
+            snaps.append(json.load(f))
+    merged = telemetry.merge_snapshots(*snaps)
+    assert merged["merged_from"] == 2
+    for key in snaps[0]["counters"]:
+        expected = sum(s["counters"].get(key, 0.0) for s in snaps)
+        assert merged["counters"][key] == pytest.approx(expected)
+    series = 'query_s{component="mh"}'
+    exact = np.asarray(
+        [k * 1e-3 * (10.0 ** pid) for pid in range(2) for k in range(1, 33)]
+    )
+    summary = merged["histograms"][series]
+    assert summary["count"] == exact.size
+    alpha = merged["histogram_relative_accuracy"]
+    for q, label in ((0.5, "p50"), (0.99, "p99")):
+        want = np.quantile(exact, q, method="lower")
+        assert abs(summary[label] - want) <= 2 * alpha * abs(want) + 1e-9, (
+            label, summary[label], want,
+        )
