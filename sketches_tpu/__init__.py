@@ -14,7 +14,15 @@ Two execution tiers:
   or the Pallas kernel), ``merge`` as ``lax.psum`` over a device mesh.
 """
 
-from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
+from sketches_tpu import (
+    accuracy,
+    faults,
+    integrity,
+    profiling,
+    resilience,
+    serve,
+    telemetry,
+)
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
     DDSketch,
@@ -27,10 +35,12 @@ from sketches_tpu.integrity import IntegrityReport
 from sketches_tpu.resilience import (
     BlobTooLarge,
     CheckpointCorrupt,
+    DeadlineExceeded,
     EngineUnavailable,
     InjectedFault,
     IntegrityError,
     QuarantineReport,
+    ServeOverload,
     ShardLossError,
     ShardLossReport,
     SketchError,
@@ -54,7 +64,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.10.0"
+__version__ = "0.11.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -88,6 +98,10 @@ __all__ = [
     "accuracy",
     # Integrity layer (invariant checks, fingerprints, repair)
     "integrity",
+    # Serving tier (admission control, deadlines, hedging, result cache)
+    "serve",
+    "ServeOverload",
+    "DeadlineExceeded",
     "IntegrityError",
     "IntegrityReport",
     "SketchError",

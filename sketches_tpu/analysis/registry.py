@@ -34,6 +34,8 @@ __all__ = [
     "INTEGRITY",
     "PROFILING",
     "ACCURACY_AUDIT",
+    "SERVE_CACHE",
+    "SERVE_HEDGE",
     "REGISTRY",
     "declared",
     "get",
@@ -144,6 +146,30 @@ ACCURACY_AUDIT = EnvVar(
     ),
 )
 
+#: Serving-tier result-cache kill switch (``sketches_tpu.serve``).
+SERVE_CACHE = EnvVar(
+    name="SKETCHES_TPU_SERVE_CACHE",
+    default="1",
+    owner="sketches_tpu.serve",
+    doc=(
+        "Set to 0 to disable the serving tier's fingerprint-keyed"
+        " result cache (every query recomputes; no fingerprint fetch,"
+        " no poison checks)."
+    ),
+)
+
+#: Serving-tier hedged-retry kill switch (``sketches_tpu.serve``).
+SERVE_HEDGE = EnvVar(
+    name="SKETCHES_TPU_SERVE_HEDGE",
+    default="1",
+    owner="sketches_tpu.serve",
+    doc=(
+        "Set to 0 to disable hedged retries for straggling serve"
+        " dispatches; a straggler's failure then surfaces to the"
+        " request as a structured error instead of being hedged around."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
@@ -151,7 +177,7 @@ REGISTRY: Dict[str, EnvVar] = {
     v.name: v
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
-        ACCURACY_AUDIT,
+        ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE,
     )
 }
 

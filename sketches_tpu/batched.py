@@ -1237,20 +1237,26 @@ class BatchedDDSketch:
         """The dispatched query callable (engine ladder in ``__init__``)."""
         return self._query_choice(qs_tuple)[1]
 
-    def _query_choice(self, qs_tuple: tuple):
+    def _query_choice(self, qs_tuple: tuple, extra_disabled: frozenset = frozenset()):
         """The query dispatch -> ``(tier, fn)`` (engine ladder in
         ``__init__``; ``tier`` names the resilience ladder rung so a
         failure can demote exactly the engine that failed).
 
-        Each plan costs one small host fetch the first query after a state
-        mutation; repeat queries reuse it.  Jits cache per static plan
-        shape -- a window/tile-list that merely *slides* recompiles
-        nothing (positions are traced).
+        ``extra_disabled`` adds caller-scoped tier exclusions on top of
+        the facade's own health ladder -- the serving tier's circuit
+        breaker and deadline floor-skip ride this without mutating the
+        facade's persistent demotion state.  Each plan costs one small
+        host fetch the first query after a state mutation; repeat
+        queries reuse it.  Jits cache per static plan shape -- a
+        window/tile-list that merely *slides* recompiles nothing
+        (positions are traced).
         """
         from sketches_tpu import kernels
 
         q_total = len(qs_tuple)
         disabled = self._query_disabled
+        if extra_disabled:
+            disabled = self._query_disabled | extra_disabled
         if self._pallas_query and "windowed" not in disabled:
             if self._window_plan is None:
                 self._window_plan = kernels.plan_state_window(
@@ -1363,8 +1369,17 @@ class BatchedDDSketch:
         tier re-raises.  Queries are pure (no state mutation), so a retry
         after any failure is always sound.
         """
+        return self._run_query_tiered(qs_tuple, qs_arr)[1]
+
+    def _run_query_tiered(
+        self, qs_tuple: tuple, qs_arr: jax.Array,
+        extra_disabled: frozenset = frozenset(),
+    ):
+        """:meth:`_run_query` that also reports the resolved tier ->
+        ``(tier, values)``; failures degrade identically (the floor
+        re-raises)."""
         while True:
-            tier, fn = self._query_choice(qs_tuple)
+            tier, fn = self._query_choice(qs_tuple, extra_disabled)
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
@@ -1377,7 +1392,7 @@ class BatchedDDSketch:
                     )
                 if _p0 is not None:
                     profiling.record("query", tier, _p0, out)
-                return out
+                return tier, out
             except Exception as e:
                 if not self._demote_query(tier, e):
                     raise
@@ -1401,6 +1416,25 @@ class BatchedDDSketch:
         """Fused multi-quantile (e.g. p50/p90/p99/p999) -> ``[n_streams, Q]``."""
         qs = [float(q) for q in quantiles]
         return self._run_query(tuple(qs), jnp.asarray(qs))
+
+    def get_quantile_values_resolved(
+        self, quantiles: Sequence[float], disabled_tiers: Sequence[str] = (),
+    ):
+        """Fused multi-quantile that also names the engine tier that
+        answered -> ``(tier, [n_streams, Q])``.
+
+        ``disabled_tiers`` excludes ladder rungs for THIS call only (the
+        serving tier's circuit breaker / deadline floor-skip), without
+        touching the facade's persistent health-ladder state.  Failures
+        degrade down the remaining rungs exactly like
+        :meth:`get_quantile_values`; disabling everything above the
+        ``xla`` floor is always answerable, and a floor failure still
+        re-raises.  Empty streams answer NaN.
+        """
+        qs = [float(q) for q in quantiles]
+        return self._run_query_tiered(
+            tuple(qs), jnp.asarray(qs), frozenset(disabled_tiers)
+        )
 
     def merge(self, other: "BatchedDDSketch") -> "BatchedDDSketch":
         """Fold ``other`` into self (consumes neither spec; checks mergeability).

@@ -31,6 +31,17 @@ bookkeeping mismatch -- makes the campaign exit non-zero.  The whole
 campaign is seeded (``np.random.default_rng(seed)`` plus the fault
 plans' own seeds): a failing run replays exactly.
 
+``--campaign serve`` runs the SERVING campaign instead: a seeded Zipf
+tenant mix drives a :class:`sketches_tpu.serve.SketchServer` (ingest /
+query / batched flush) while the ``serve.*`` sites inject stragglers,
+forced queue overflows, and cache poison.  The accounting contract is
+the serving tier's robustness envelope: every injected fault must be
+**shed** (``ServeOverload``, structured reason), **hedged** around
+(answer bit-identical to a direct engine query), or **detected**
+(poisoned cache entry quarantined and recomputed, answer exact) -- and
+the tenants' total mass must be conserved.  Anything else is
+``undetected`` and fails the run.
+
 Failure modes: the harness itself raises ``SketchValueError`` on
 invalid arguments; a campaign that cannot complete (unexpected
 exception escaping an un-faulted op) records the error in the verdict
@@ -56,7 +67,7 @@ from sketches_tpu.resilience import (
     SketchValueError,
 )
 
-__all__ = ["run_campaign", "main"]
+__all__ = ["run_campaign", "run_serve_campaign", "main"]
 
 #: Campaign shape: small enough that a 500+-step soak runs in CI
 #: minutes, big enough that every store/seam carries real mass.
@@ -432,6 +443,205 @@ def run_campaign(
             own_tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# Serving campaign (the serve.* sites)
+# ---------------------------------------------------------------------------
+
+#: Serving-campaign shape: a few tenants (two sharing a spec, so the
+#: cross-tenant fused dispatch path is exercised), small states.
+_SERVE_TENANTS = ("alpha", "beta", "gamma", "delta")
+_SERVE_STREAMS = 8
+_SERVE_QS = ((0.5,), (0.9,), (0.5, 0.99), (0.25, 0.5, 0.9, 0.99))
+
+
+def _serve_direct(server, tenant: str, qs) -> np.ndarray:
+    """The oracle for a served answer: the tenant facade's own fused
+    query (bit-identical is the contract -- serving must never change
+    an answer, only its latency)."""
+    return np.asarray(server.tenant(tenant).get_quantile_values(list(qs)))
+
+
+def _serve_fault_straggler(server, rng, counts) -> str:
+    from sketches_tpu.resilience import SketchError
+
+    tenant = _SERVE_TENANTS[int(rng.integers(len(_SERVE_TENANTS)))]
+    qs = _SERVE_QS[int(rng.integers(len(_SERVE_QS)))]
+    before = server.stats()["hedges"]
+    with faults.active({faults.SERVE_STRAGGLER: dict(times=1)}) as plans:
+        try:
+            result = server.query(tenant, qs)
+        except SketchError:
+            return "undetected"  # a straggler must be hedged, not failed
+        fired = plans[faults.SERVE_STRAGGLER].fired
+    if fired == 0:
+        return "skipped"  # answered from cache: no dispatch to straggle
+    hedged = server.stats()["hedges"] > before
+    exact = np.array_equal(
+        result.values, _serve_direct(server, tenant, qs), equal_nan=True
+    )
+    return "hedged" if (hedged and exact) else "undetected"
+
+
+def _serve_fault_overflow(server, rng, counts) -> str:
+    from sketches_tpu.resilience import ServeOverload
+
+    tenant = _SERVE_TENANTS[int(rng.integers(len(_SERVE_TENANTS)))]
+    before = server.stats()["shed"]
+    with faults.active({faults.SERVE_QUEUE_OVERFLOW: dict(times=1)}) as plans:
+        try:
+            # A fresh quantile defeats the admission cache so the
+            # request reaches the overflow seam.
+            server.submit(tenant, (0.013 + 0.02 * (counts["overflow"] % 17),))
+            fired = plans[faults.SERVE_QUEUE_OVERFLOW].fired
+            if fired == 0:
+                return "skipped"
+            return "undetected"  # the forced overflow was not shed
+        except ServeOverload as e:
+            counts["overflow"] += 1
+            shed_counted = server.stats()["shed"] > before
+            return (
+                "shed" if (e.reason == "injected" and shed_counted)
+                else "undetected"
+            )
+    return "undetected"
+
+
+def _serve_fault_cache_poison(server, rng, counts) -> str:
+    tenant = _SERVE_TENANTS[int(rng.integers(len(_SERVE_TENANTS)))]
+    qs = _SERVE_QS[int(rng.integers(len(_SERVE_QS)))]
+    server.query(tenant, qs)  # ensure the entry exists (fill or hit)
+    before = server.stats()["cache_poisoned"]
+    with faults.active({faults.SERVE_CACHE_POISON: dict(times=1)}) as plans:
+        result = server.query(tenant, qs)
+        fired = plans[faults.SERVE_CACHE_POISON].fired
+    if fired == 0:
+        return "skipped"  # cache disarmed / entry evicted: nothing to poison
+    detected = server.stats()["cache_poisoned"] > before
+    exact = np.array_equal(
+        result.values, _serve_direct(server, tenant, qs), equal_nan=True
+    )
+    return "detected" if (detected and exact and not result.cached) \
+        else "undetected"
+
+
+_SERVE_FAULT_DRIVERS = {
+    faults.SERVE_STRAGGLER: _serve_fault_straggler,
+    faults.SERVE_QUEUE_OVERFLOW: _serve_fault_overflow,
+    faults.SERVE_CACHE_POISON: _serve_fault_cache_poison,
+}
+
+
+def run_serve_campaign(steps: int, seed: int) -> Dict[str, Any]:
+    """Run the seeded serving chaos campaign -> the verdict document.
+
+    Drives a 4-tenant :class:`~sketches_tpu.serve.SketchServer` (two
+    tenants share a spec, exercising the cross-tenant fused dispatch)
+    with a seeded mixed read/write workload while the three ``serve.*``
+    fault sites inject.  ``ok`` is True iff every injected fault was
+    shed, hedged around, or detected (answers bit-identical to a direct
+    engine query), total tenant mass is conserved, and no unexpected
+    error escaped.  Raises ``SketchValueError`` for non-positive
+    ``steps``; campaign-level failures are reported, not raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    from sketches_tpu import serve
+    from sketches_tpu.batched import SketchSpec
+
+    faults.disarm()
+    rng = np.random.default_rng(seed)
+    shared = SketchSpec(relative_accuracy=_REL_ACC, n_bins=_N_BINS)
+    own = SketchSpec(relative_accuracy=0.01, n_bins=_N_BINS)
+    server = serve.SketchServer(
+        serve.ServeConfig(max_queue_depth=64, tenant_quota=16)
+    )
+    specs = {"alpha": shared, "beta": shared, "gamma": own, "delta": own}
+    for name in _SERVE_TENANTS:
+        server.add_tenant(name, _SERVE_STREAMS, spec=specs[name])
+    expected = {name: 0.0 for name in _SERVE_TENANTS}
+    events: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    counts = {"overflow": 0}
+    sites = tuple(_SERVE_FAULT_DRIVERS)
+
+    def _ingest(step: int) -> None:
+        name = _SERVE_TENANTS[int(rng.integers(len(_SERVE_TENANTS)))]
+        vals = rng.lognormal(0.0, 0.5, (_SERVE_STREAMS, _BATCH))
+        server.ingest(name, vals.astype(np.float32))
+        expected[name] += _SERVE_STREAMS * _BATCH
+
+    def _query(step: int) -> None:
+        name = _SERVE_TENANTS[int(rng.integers(len(_SERVE_TENANTS)))]
+        qs = _SERVE_QS[int(rng.integers(len(_SERVE_QS)))]
+        result = server.query(name, qs)
+        if not np.array_equal(
+            result.values, _serve_direct(server, name, qs), equal_nan=True
+        ):
+            raise SketchError(
+                f"served answer for {name!r} diverged from the engine"
+            )
+
+    def _batch(step: int) -> None:
+        tickets = []
+        for name in _SERVE_TENANTS:
+            qs = _SERVE_QS[int(rng.integers(len(_SERVE_QS)))]
+            tickets.append(server.submit(name, qs))
+        results = server.flush()
+        for tk in tickets:
+            if tk.result is None and tk.id not in results:
+                raise SketchError("an admitted ticket went unanswered")
+
+    ops = (_ingest, _query, _batch)
+    weights = (0.4, 0.4, 0.2)
+    for step in range(steps):
+        op = int(rng.choice(len(ops), p=weights))
+        try:
+            ops[op](step)
+        except Exception as e:  # un-faulted serving op must not fail
+            errors.append(f"step {step} op {ops[op].__name__}: {e!r}")
+            break
+        if rng.random() < _FAULT_P:
+            site = sites[int(rng.integers(len(sites)))]
+            try:
+                outcome = _SERVE_FAULT_DRIVERS[site](server, rng, counts)
+            except Exception as e:
+                outcome = "undetected"
+                errors.append(f"step {step} site {site}: {e!r}")
+            if outcome != "skipped":
+                events.append({"step": step, "site": site, "outcome": outcome})
+    # Mass audit: every ingested value is still in its tenant's sketch.
+    conserved = True
+    for name in _SERVE_TENANTS:
+        got = float(
+            np.asarray(
+                server.tenant(name).state.count, np.float64
+            ).sum()
+        )
+        if abs(got - expected[name]) > max(1.0, 1e-5 * expected[name]):
+            conserved = False
+            errors.append(
+                f"tenant {name!r} mass {got:g} != expected {expected[name]:g}"
+            )
+    outcomes: Dict[str, int] = {}
+    for ev in events:
+        outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+    ok = conserved and not errors and outcomes.get("undetected", 0) == 0
+    return {
+        "campaign": "serve",
+        "steps": steps,
+        "seed": seed,
+        "ok": ok,
+        "n_faults": len(events),
+        "outcomes": outcomes,
+        "events": events,
+        "errors": errors,
+        "expected_count": sum(expected.values()),
+        "serve_stats": server.stats(),
+        "health": resilience.health(),
+        "telemetry": telemetry.snapshot() if telemetry.enabled() else None,
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry point: run the campaign, write the verdict, exit 0 iff
     every injected fault was accounted for (1 otherwise).
@@ -451,8 +661,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--campaign", choices=("core", "serve"), default="core",
+        help="core: the integrity soak over the storage/engine sites;"
+        " serve: the serving-tier soak over the serve.* sites (every"
+        " fault shed, hedged, or detected)",
+    )
+    parser.add_argument(
         "--mode", choices=("raise", "quarantine"), default="raise",
-        help="armed integrity behavior during the soak",
+        help="armed integrity behavior during the (core) soak",
     )
     parser.add_argument(
         "--out", default=None, metavar="PATH",
@@ -466,7 +682,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
-    verdict = run_campaign(args.steps, args.seed, mode=args.mode)
+    if args.campaign == "serve":
+        verdict = run_serve_campaign(args.steps, args.seed)
+    else:
+        verdict = run_campaign(args.steps, args.seed, mode=args.mode)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(verdict, f, indent=1, sort_keys=True)

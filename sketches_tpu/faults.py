@@ -22,6 +22,16 @@ site                    simulates
                         bin vector -- consumed by the chaos harness via
                         :func:`state_bitflips` + :func:`apply_state_bitflips`
                         (the integrity layer's adversary)
+``serve.straggler``     a straggling query dispatch in the serving tier
+                        (raises at the serve dispatch, per engine ``tier``)
+                        -- the hedged-retry adversary
+``serve.cache_poison``  silent corruption of a cached serving result --
+                        consumed by the serve cache via
+                        :func:`cache_poison_flip` (returns flip
+                        coordinates rather than raising)
+``serve.queue_overflow``  forced admission-queue overflow in the serving
+                        tier (raises at admission; the request must be
+                        shed with a structured error, never hang)
 ======================  ====================================================
 
 Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
@@ -58,6 +68,9 @@ __all__ = [
     "CHECKPOINT_WRITE",
     "MESH_SHARD",
     "STATE_BITFLIP",
+    "SERVE_STRAGGLER",
+    "SERVE_CACHE_POISON",
+    "SERVE_QUEUE_OVERFLOW",
     "SITES",
     "arm",
     "disarm",
@@ -66,6 +79,7 @@ __all__ = [
     "dead_shards",
     "state_bitflips",
     "apply_state_bitflips",
+    "cache_poison_flip",
     "stats",
     "corrupt_blobs",
 ]
@@ -81,6 +95,9 @@ WIRE_BLOB = "wire.blob"
 CHECKPOINT_WRITE = "checkpoint.write"
 MESH_SHARD = "mesh.shard"
 STATE_BITFLIP = "state.bitflip"
+SERVE_STRAGGLER = "serve.straggler"
+SERVE_CACHE_POISON = "serve.cache_poison"
+SERVE_QUEUE_OVERFLOW = "serve.queue_overflow"
 
 SITES = (
     NATIVE_LOAD,
@@ -90,6 +107,9 @@ SITES = (
     CHECKPOINT_WRITE,
     MESH_SHARD,
     STATE_BITFLIP,
+    SERVE_STRAGGLER,
+    SERVE_CACHE_POISON,
+    SERVE_QUEUE_OVERFLOW,
 )
 
 #: Fast-path guard: seams check this module flag before calling
@@ -302,6 +322,34 @@ def apply_state_bitflips(state, flips):
         bins_pos=jnp.asarray(arrays[0]),
         bins_neg=jnp.asarray(arrays[1]),
     )
+
+
+def cache_poison_flip(n_bytes: int) -> Optional[Tuple[int, int]]:
+    """Armed cached-result corruption coordinates -- the
+    ``serve.cache_poison`` site's consumer-side read (it returns data
+    rather than raising, like :func:`state_bitflips`).
+
+    Each firing yields one ``(byte, bit)`` coordinate into a cached
+    payload of ``n_bytes`` bytes, derived deterministically from the
+    plan's seed and its running call count, so a failing sequence
+    replays exactly.  Disarmed (the default) it returns ``None`` after
+    one bool test; an empty payload also returns ``None`` (nothing to
+    corrupt).  Respects the plan's ``times`` cap.
+    """
+    if not _ACTIVE:
+        return None
+    plan = _plans.get(SERVE_CACHE_POISON)
+    if plan is None or n_bytes <= 0:
+        return None
+    plan.calls += 1
+    if plan.times is not None and plan.fired >= plan.times:
+        return None
+    h = binascii.crc32(f"{plan.seed}:{plan.calls}".encode()) & 0xFFFFFFFF
+    byte = h % n_bytes
+    bit = (h >> 24) % 8
+    plan.fired += 1
+    bump("faults." + SERVE_CACHE_POISON)
+    return (byte, bit)
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,8 @@ __all__ = [
     "EngineUnavailable",
     "ShardLossError",
     "InjectedFault",
+    "ServeOverload",
+    "DeadlineExceeded",
     "QuarantineRecord",
     "QuarantineReport",
     "ShardLossReport",
@@ -148,6 +150,28 @@ class ShardLossError(SketchError):
 
 class InjectedFault(SketchError):
     """The deterministic failure raised by an armed ``faults`` site."""
+
+
+class ServeOverload(SketchError):
+    """A serving request was shed at admission (``sketches_tpu.serve``):
+    the global queue was at depth, the tenant was over quota, or an
+    armed ``serve.queue_overflow`` fault forced the overflow path.
+    Shedding is deliberate degradation, never silent: every shed bumps
+    the ``serve.shed`` health counter and the declared telemetry
+    metrics.  ``reason`` is the stable shed class
+    (``queue_depth`` / ``tenant_quota`` / ``injected``)."""
+
+    def __init__(self, message: str, reason: str = "", tenant: str = ""):
+        super().__init__(message)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class DeadlineExceeded(SketchError):
+    """A serving request's deadline budget was already spent before any
+    dispatch could answer it (``sketches_tpu.serve``).  Raised at
+    admission/flush time -- a request near (but not past) its deadline
+    degrades to the cheapest engine tier instead of raising."""
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,799 @@
+"""Overload-hardened multi-tenant serving tier: the paper's production story.
+
+DDSketch exists to serve p50/p99 under production traffic (PAPER.md),
+and production traffic is bursty, repetitive, and adversarial.  This
+module is the serving facade over the device tier: N tenants each own
+an isolated :class:`~sketches_tpu.batched.BatchedDDSketch` (per-tenant
+``SketchSpec``), concurrent quantile requests are admitted into a
+bounded queue and flushed as **fused device dispatches** -- requests
+for one tenant fold into a single fused multi-quantile call (the union
+of their quantiles), and tenants sharing a spec stack their states and
+answer in ONE device dispatch -- wrapped in a full robustness envelope:
+
+* **Admission control** -- a bounded queue with a declared shed order:
+  a request is refused at admission (``ServeOverload``, structured
+  ``reason``) when its tenant is over quota (``tenant_quota`` -- one
+  hot tenant cannot starve the rest) or the global queue is at depth
+  (``queue_depth``); admitted requests are NEVER evicted, and shedding
+  is counted (``serve.shed`` metric + health ledger), never silent.
+* **Deadline budgets** -- every request carries a deadline; a request
+  whose remaining budget falls under ``floor_margin_s`` at flush time
+  skips straight to the ``xla`` floor tier (already compiled, no plan
+  fetch) instead of risking a timeout on a faster-but-colder rung; a
+  budget spent before flush answers ``DeadlineExceeded``; late answers
+  are still returned but counted (``serve.deadline_misses``).
+* **Hedged retries** -- a primary dispatch that fails (the armed
+  ``serve.straggler`` site is the adversary) or straggles past
+  ``hedge_after_s`` is hedged with a floor-tier dispatch; queries are
+  pure, so the hedge is idempotent by construction and the loser's
+  result is discarded bit-identically (test-asserted).
+* **Circuit breaker per engine tier** -- repeated failures/stragglers
+  on a non-floor ladder rung (threshold ``breaker_threshold``) open
+  that tier's breaker: subsequent dispatches skip the rung (via the
+  facade's caller-scoped tier exclusion, folding into the existing
+  ``overlap -> tiles -> windowed -> wxla -> xla`` ladder) for
+  ``breaker_cooldown`` dispatches, then a half-open probe either
+  closes it or re-opens it.  The ``xla`` floor never opens (it is the
+  answer of last resort, exactly like the resilience ladder's floor).
+* **Fingerprint-keyed result cache with poison detection** -- results
+  are memoized under ``(tenant, content fingerprint, quantiles)`` using
+  the integrity layer's merge-additive fingerprints
+  (:func:`sketches_tpu.integrity.fingerprint`), so a write naturally
+  invalidates (the fingerprint moves) and identical reads are served
+  from memory bit-identical to a cold recompute.  Every hit is
+  re-verified: the entry's stored fingerprint must equal the live one
+  and its payload checksum must match (the armed
+  ``serve.cache_poison`` site corrupts entries to prove it); a
+  mismatch quarantines the entry (``serve.cache.poisoned``), and the
+  request silently recomputes -- a poisoned cache degrades to a cache
+  miss, never to a wrong answer.
+
+Determinism: the serving clock is injectable (``clock=`` -- defaults to
+``telemetry.clock``), so deadline/hedge/breaker behavior replays
+exactly under a virtual clock; no code here sleeps or reads wall time
+directly.  Kill switches (declared in ``analysis/registry.py``):
+``SKETCHES_TPU_SERVE_CACHE=0`` disables the cache (no fingerprint
+fetch, one bool test per query), ``SKETCHES_TPU_SERVE_HEDGE=0``
+disables hedging (a straggler's failure surfaces as its structured
+error instead).
+
+Failure modes: shed requests raise :class:`ServeOverload` (reason
+``queue_depth`` / ``tenant_quota`` / ``injected``), spent budgets raise
+:class:`DeadlineExceeded`, unknown tenants raise ``SpecError``; an
+engine-floor failure re-raises after the hedge path is exhausted -- a
+request is always answered, refused, or failed loudly, never hung.
+"""
+
+from __future__ import annotations
+
+import binascii
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu.analysis import registry
+from sketches_tpu.resilience import (
+    QUERY_LADDER,
+    DeadlineExceeded,
+    ServeOverload,
+    SketchError,
+    SpecError,
+    SketchValueError,
+)
+
+__all__ = [
+    "ServeConfig",
+    "Ticket",
+    "ServeResult",
+    "SketchServer",
+    "ServeOverload",
+    "DeadlineExceeded",
+]
+
+#: Non-floor ladder rungs a circuit breaker may open; the ``xla`` floor
+#: is the answer of last resort and never opens.
+_BREAKABLE_TIERS = QUERY_LADDER[:-1]
+_FLOOR_TIER = QUERY_LADDER[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving-envelope knobs (all bounded, all declared).
+
+    ``max_queue_depth`` / ``tenant_quota`` bound the admission queue
+    (overflow sheds with ``ServeOverload``; admitted requests are never
+    evicted).  ``default_deadline_s`` is the per-request budget when the
+    caller passes none; a request with less than ``floor_margin_s``
+    remaining at flush skips to the floor tier.  ``hedge_after_s`` is
+    the straggler threshold for hedged retries.  ``breaker_threshold``
+    consecutive failures open a tier's breaker for ``breaker_cooldown``
+    dispatches before the half-open probe.  ``cache_capacity`` bounds
+    the result cache (LRU past capacity; 0 disables it outright).
+    Invalid (non-positive) bounds raise ``SpecError``.
+    """
+
+    max_queue_depth: int = 256
+    tenant_quota: int = 64
+    default_deadline_s: float = 0.25
+    floor_margin_s: float = 0.02
+    hedge_after_s: float = 0.05
+    breaker_threshold: int = 3
+    breaker_cooldown: int = 8
+    cache_capacity: int = 4096
+
+    def __post_init__(self):
+        if self.max_queue_depth <= 0 or self.tenant_quota <= 0:
+            raise SpecError("queue depth and tenant quota must be positive")
+        if self.default_deadline_s <= 0:
+            raise SpecError("default_deadline_s must be positive")
+        if self.breaker_threshold <= 0 or self.breaker_cooldown <= 0:
+            raise SpecError("breaker threshold/cooldown must be positive")
+        if self.cache_capacity < 0:
+            raise SpecError("cache_capacity must be non-negative")
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One admitted (or cache-answered) quantile request.
+
+    ``deadline`` is absolute serving-clock seconds; ``result`` is
+    filled by the admission cache hit or the next :meth:`flush` --
+    ``None`` until then.  A shed request never gets a ticket (admission
+    raises instead).
+    """
+
+    id: int
+    tenant: str
+    qs: Tuple[float, ...]
+    deadline: float
+    submitted_at: float
+    result: Optional["ServeResult"] = None
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One answered request: per-stream values for the requested
+    quantiles (``[n_streams, Q]``, NaN for empty streams), the engine
+    ``tier`` that answered (``cache`` for hits), and the robustness
+    accounting -- ``hedged`` (a hedge dispatch was issued),
+    ``deadline_missed`` (answered after the budget; the answer is still
+    exact, the miss is counted)."""
+
+    values: np.ndarray
+    tier: str
+    hedged: bool = False
+    deadline_missed: bool = False
+
+    @property
+    def cached(self) -> bool:
+        return self.tier == "cache"
+
+
+class _Breaker:
+    """One engine tier's circuit breaker (request-count cooldown -- no
+    wall clock, so a failing sequence replays exactly).
+
+    closed --(``threshold`` consecutive failures)--> open
+    open --(``cooldown`` skipped dispatches)--> half_open
+    half_open --(probe success)--> closed; --(probe failure)--> open
+    """
+
+    __slots__ = ("threshold", "cooldown", "failures", "state", "cooldown_left")
+
+    def __init__(self, threshold: int, cooldown: int):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.failures = 0
+        self.state = "closed"
+        self.cooldown_left = 0
+
+    def blocks(self) -> bool:
+        """Whether this dispatch must skip the tier (advances cooldown)."""
+        if self.state == "open":
+            self.cooldown_left -= 1
+            if self.cooldown_left <= 0:
+                self.state = "half_open"
+            return True
+        return False  # closed and half_open both allow (probe) traffic
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.state = "closed"
+
+    def record_failure(self) -> bool:
+        """Count a failure -> True iff the breaker (re-)opened."""
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.threshold:
+            self.state = "open"
+            self.cooldown_left = self.cooldown
+            self.failures = 0
+            return True
+        return False
+
+
+class _CacheEntry:
+    __slots__ = ("fp", "values", "tier", "checksum")
+
+    def __init__(self, fp: np.ndarray, values: np.ndarray, tier: str):
+        self.fp = fp
+        self.values = values
+        self.tier = tier
+        self.checksum = _payload_checksum(fp, values)
+
+
+def _payload_checksum(fp: np.ndarray, values: np.ndarray) -> int:
+    """Content checksum binding a cached payload to its fingerprint
+    (crc32 over both byte images; any single-bit rot in either fails
+    re-verification).  Never raises on well-formed arrays."""
+    crc = binascii.crc32(np.ascontiguousarray(fp).tobytes())
+    return binascii.crc32(np.ascontiguousarray(values).tobytes(), crc)
+
+
+class _Tenant:
+    __slots__ = ("name", "facade", "version", "fp_cache")
+
+    def __init__(self, name: str, facade):
+        self.name = name
+        self.facade = facade
+        self.version = 0  # bumped on every server-mediated write
+        self.fp_cache: Optional[Tuple[int, np.ndarray, bytes]] = None
+
+
+class SketchServer:
+    """The multi-tenant serving facade (module docstring for the full
+    envelope: admission/shedding, deadlines, hedging, breakers, cache).
+
+    Writes MUST go through :meth:`ingest`/:meth:`merge` (or be followed
+    by :meth:`invalidate`): the result cache keys on content
+    fingerprints that the server memoizes per tenant write-version, so
+    a write behind the server's back would serve stale (but
+    detectable: the live-fingerprint re-verification quarantines such
+    entries on the next hit).  Unknown tenants raise ``SpecError``;
+    shed requests raise ``ServeOverload``; spent deadline budgets raise
+    ``DeadlineExceeded``.  Thread-safe for submit/flush under one lock
+    (dispatches serialize -- the device is one resource).
+    """
+
+    def __init__(
+        self,
+        config: Optional[ServeConfig] = None,
+        *,
+        clock: Optional[Callable[[], float]] = None,
+    ):
+        self.config = config or ServeConfig()
+        self._clock = clock if clock is not None else telemetry.clock
+        self._tenants: Dict[str, _Tenant] = {}
+        self._queue: List[Ticket] = []
+        self._pending_per_tenant: Dict[str, int] = {}
+        self._next_id = 0
+        self._lock = threading.RLock()
+        # Kill switches: read once (registry discipline); disarmed cost
+        # is one bool test per query / dispatch.
+        self._cache_enabled = (
+            registry.enabled(registry.SERVE_CACHE)
+            and self.config.cache_capacity > 0
+        )
+        self._hedge_enabled = registry.enabled(registry.SERVE_HEDGE)
+        self._cache: "Dict[Tuple[str, bytes, Tuple[float, ...]], _CacheEntry]" = {}
+        self._cache_order: List[Tuple[str, bytes, Tuple[float, ...]]] = []
+        self._breakers: Dict[str, _Breaker] = {}
+        self._fused_jits: Dict[Any, Any] = {}
+        self._stats: Dict[str, float] = {
+            "requests": 0, "shed": 0, "deadline_misses": 0, "hedges": 0,
+            "cache_hits": 0, "cache_misses": 0, "cache_poisoned": 0,
+            "dispatches": 0, "fused_dispatches": 0, "breaker_trips": 0,
+        }
+
+    # -- tenancy ----------------------------------------------------------
+
+    def add_tenant(self, name: str, n_streams: int, **kwargs):
+        """Register tenant ``name`` with its own isolated facade (and
+        therefore its own ``SketchSpec``) -> the facade.
+
+        ``kwargs`` pass through to ``BatchedDDSketch`` (``spec=``,
+        ``relative_accuracy=``, ``n_bins=``, ...).  Re-registering an
+        existing name raises ``SpecError`` -- tenant state is never
+        silently replaced.
+        """
+        from sketches_tpu.batched import BatchedDDSketch
+
+        with self._lock:
+            if name in self._tenants:
+                raise SpecError(f"tenant {name!r} already registered")
+            facade = BatchedDDSketch(n_streams, **kwargs)
+            self._tenants[name] = _Tenant(name, facade)
+            return facade
+
+    def tenant(self, name: str):
+        """The named tenant's facade (raises ``SpecError`` if unknown)."""
+        return self._tenant(name).facade
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            raise SpecError(f"unknown tenant {name!r}")
+        return t
+
+    # -- write path -------------------------------------------------------
+
+    def ingest(self, name: str, values, weights=None) -> None:
+        """Ingest a batch into tenant ``name`` (write path).
+
+        Bumps the tenant's write version, so cached fingerprints (and
+        therefore cached results) invalidate naturally -- the next read
+        recomputes.  Ingest failures degrade/raise exactly as the
+        facade's engine ladder does.
+        """
+        t = self._tenant(name)
+        with self._lock:
+            t.facade.add(values, weights)
+            t.version += 1
+            t.fp_cache = None
+
+    def merge(self, name: str, other) -> None:
+        """Fold another ``BatchedDDSketch`` into tenant ``name`` (write
+        path; same invalidation discipline as :meth:`ingest`).  Unequal
+        specs raise ``UnequalSketchParametersError``."""
+        t = self._tenant(name)
+        with self._lock:
+            t.facade.merge(other)
+            t.version += 1
+            t.fp_cache = None
+
+    def invalidate(self, name: str) -> None:
+        """Drop tenant ``name``'s memoized fingerprint after an
+        out-of-band write to its facade (raises ``SpecError`` when the
+        tenant is unknown).  Without this, stale entries are still
+        caught -- the hit-time live-fingerprint re-verification
+        quarantines them -- but at hit-time cost."""
+        t = self._tenant(name)
+        with self._lock:
+            t.version += 1
+            t.fp_cache = None
+
+    # -- fingerprints & cache --------------------------------------------
+
+    def _fingerprint(self, t: _Tenant) -> Tuple[np.ndarray, bytes]:
+        """Tenant content fingerprint (memoized per write version)."""
+        cached = t.fp_cache
+        if cached is not None and cached[0] == t.version:
+            return cached[1], cached[2]
+        fp = integrity.fingerprint(t.facade.spec, t.facade.state)
+        digest = np.ascontiguousarray(fp).tobytes()
+        t.fp_cache = (t.version, fp, digest)
+        return fp, digest
+
+    def _cache_get(
+        self, t: _Tenant, qs: Tuple[float, ...]
+    ) -> Optional[np.ndarray]:
+        """Cache lookup with poison detection -> values (a defensive
+        copy) or None.  A hit is re-verified (live fingerprint + payload
+        checksum); a mismatch quarantines the entry, counts it, and
+        reads as a miss -- the request recomputes."""
+        fp, digest = self._fingerprint(t)
+        key = (t.name, digest, qs)
+        entry = self._cache.get(key)
+        if entry is None:
+            return None
+        if faults._ACTIVE:
+            flip = faults.cache_poison_flip(entry.values.nbytes)
+            if flip is not None:
+                # The armed adversary: silent rot in the cached payload.
+                buf = np.ascontiguousarray(entry.values).copy()
+                view = buf.view(np.uint8).reshape(-1)
+                view[flip[0]] ^= np.uint8(1 << flip[1])
+                entry.values = buf
+        live_ok = entry.fp.shape == fp.shape and bool(
+            np.array_equal(entry.fp, fp)
+        )
+        sum_ok = entry.checksum == _payload_checksum(entry.fp, entry.values)
+        if not (live_ok and sum_ok):
+            self._quarantine(key)
+            return None
+        # LRU touch.
+        try:
+            self._cache_order.remove(key)
+        except ValueError:  # pragma: no cover - defensive
+            pass
+        self._cache_order.append(key)
+        return entry.values.copy()
+
+    def _quarantine(self, key) -> None:
+        self._cache.pop(key, None)
+        try:
+            self._cache_order.remove(key)
+        except ValueError:
+            pass
+        self._stats["cache_poisoned"] += 1
+        resilience.bump("serve.cache_poisoned")
+        if telemetry._ACTIVE:
+            telemetry.counter_inc("serve.cache.poisoned")
+
+    def _cache_put(
+        self, t: _Tenant, qs: Tuple[float, ...], fp: np.ndarray,
+        digest: bytes, values: np.ndarray, tier: str,
+    ) -> None:
+        key = (t.name, digest, qs)
+        if key not in self._cache:
+            self._cache_order.append(key)
+        self._cache[key] = _CacheEntry(fp, values, tier)
+        while len(self._cache_order) > self.config.cache_capacity:
+            old = self._cache_order.pop(0)
+            self._cache.pop(old, None)
+
+    # -- admission --------------------------------------------------------
+
+    def _shed(self, tenant: str, reason: str) -> None:
+        self._stats["shed"] += 1
+        resilience.bump("serve.shed")
+        if telemetry._ACTIVE:
+            telemetry.counter_inc("serve.shed", reason=reason)
+        raise ServeOverload(
+            f"request for tenant {tenant!r} shed at admission ({reason})",
+            reason=reason, tenant=tenant,
+        )
+
+    def submit(
+        self,
+        name: str,
+        quantiles: Sequence[float],
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit one quantile request -> a :class:`Ticket`.
+
+        A cache hit answers immediately (``ticket.result`` set, no
+        queue slot consumed).  Otherwise admission applies the declared
+        shed order -- injected-overflow fault, then tenant quota, then
+        global depth -- and a refused request raises
+        :class:`ServeOverload` (structured ``reason``); a deadline
+        budget that is already non-positive raises
+        :class:`DeadlineExceeded`.  Admitted requests are never
+        evicted; :meth:`flush` answers them.
+        """
+        qs = tuple(sorted(float(q) for q in quantiles))
+        if not qs:
+            raise SketchValueError("a request needs at least one quantile")
+        with self._lock:
+            t = self._tenant(name)
+            self._stats["requests"] += 1
+            now = self._clock()
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("serve.requests")
+            budget = (
+                self.config.default_deadline_s
+                if deadline_s is None else float(deadline_s)
+            )
+            if budget <= 0:
+                self._stats["deadline_misses"] += 1
+                resilience.bump("serve.deadline_misses")
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("serve.deadline_misses")
+                raise DeadlineExceeded(
+                    f"request for tenant {name!r} arrived with a spent"
+                    f" deadline budget ({budget:g}s)"
+                )
+            ticket = Ticket(
+                id=self._next_id, tenant=name, qs=qs,
+                deadline=now + budget, submitted_at=now,
+            )
+            self._next_id += 1
+            if self._cache_enabled:
+                values = self._cache_get(t, qs)
+                if values is not None:
+                    self._stats["cache_hits"] += 1
+                    if telemetry._ACTIVE:
+                        telemetry.counter_inc("serve.cache.hits")
+                        telemetry.observe(
+                            "serve.request_s", self._clock() - now,
+                            source="cache",
+                        )
+                    ticket.result = ServeResult(values=values, tier="cache")
+                    return ticket
+                self._stats["cache_misses"] += 1
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("serve.cache.misses")
+            if faults._ACTIVE:
+                try:
+                    faults.inject(faults.SERVE_QUEUE_OVERFLOW)
+                except SketchError:
+                    self._shed(name, "injected")
+            if self._pending_per_tenant.get(name, 0) >= self.config.tenant_quota:
+                self._shed(name, "tenant_quota")
+            if len(self._queue) >= self.config.max_queue_depth:
+                self._shed(name, "queue_depth")
+            self._queue.append(ticket)
+            self._pending_per_tenant[name] = (
+                self._pending_per_tenant.get(name, 0) + 1
+            )
+            if telemetry._ACTIVE:
+                telemetry.gauge_set("serve.queue_depth", len(self._queue))
+            return ticket
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _breaker(self, tier: str) -> _Breaker:
+        b = self._breakers.get(tier)
+        if b is None:
+            b = self._breakers[tier] = _Breaker(
+                self.config.breaker_threshold, self.config.breaker_cooldown
+            )
+        return b
+
+    def breaker_state(self, tier: str) -> str:
+        """The named tier's breaker state (``closed`` when it has never
+        failed; unknown tiers raise ``SpecError``)."""
+        if tier not in QUERY_LADDER:
+            raise SpecError(f"unknown engine tier {tier!r}")
+        b = self._breakers.get(tier)
+        return b.state if b is not None else "closed"
+
+    def _breaker_failure(self, tier: str) -> None:
+        if tier not in _BREAKABLE_TIERS:
+            return
+        if self._breaker(tier).record_failure():
+            self._stats["breaker_trips"] += 1
+            resilience.record_downgrade(
+                "serve.breaker", tier, "open", "circuit breaker tripped"
+            )
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("serve.breaker.trips", tier=tier)
+
+    def _blocked_tiers(self) -> frozenset:
+        blocked = set()
+        for tier, b in self._breakers.items():
+            if b.blocks():
+                blocked.add(tier)
+        return frozenset(blocked)
+
+    def _hedge(self, t: _Tenant, qs: Tuple[float, ...]) -> np.ndarray:
+        """The hedge dispatch: the already-compiled ``xla`` floor --
+        pure, so idempotent with the primary by construction.  A floor
+        failure re-raises (nothing cheaper exists)."""
+        self._stats["hedges"] += 1
+        resilience.bump("serve.hedges")
+        if telemetry._ACTIVE:
+            telemetry.counter_inc("serve.hedges", tier=_FLOOR_TIER)
+        _, values = t.facade.get_quantile_values_resolved(
+            qs, disabled_tiers=_BREAKABLE_TIERS
+        )
+        return np.asarray(values)
+
+    def _dispatch_tenant(
+        self, t: _Tenant, qs: Tuple[float, ...], force_floor: bool
+    ) -> Tuple[str, np.ndarray, bool]:
+        """One tenant's fused dispatch through the robustness envelope
+        -> ``(tier, values, hedged)``.  Stragglers (injected or slower
+        than ``hedge_after_s``) are hedged on the floor tier when
+        hedging is enabled; with hedging disabled a straggler's failure
+        re-raises to the caller."""
+        disabled = self._blocked_tiers()
+        if force_floor:
+            disabled = disabled | frozenset(_BREAKABLE_TIERS)
+        # Resolve the tier first (plan fetch, memoized) so the armed
+        # straggler site can target one rung, then dispatch on it.
+        tier = t.facade._query_choice(qs, disabled)[0]
+        t0 = self._clock()
+        try:
+            if faults._ACTIVE:
+                faults.inject(faults.SERVE_STRAGGLER, tier=tier)
+            tier, values = t.facade.get_quantile_values_resolved(
+                qs, disabled_tiers=disabled
+            )
+        except SketchError as e:
+            self._breaker_failure(tier)
+            if not self._hedge_enabled:
+                raise
+            resilience.record_downgrade(
+                "serve.dispatch", tier, _FLOOR_TIER, f"hedged: {e!r}"
+            )
+            return _FLOOR_TIER, self._hedge(t, qs), True
+        elapsed = self._clock() - t0
+        values = np.asarray(values)
+        if (
+            self._hedge_enabled
+            and tier != _FLOOR_TIER
+            and elapsed > self.config.hedge_after_s
+        ):
+            # The primary straggled but completed: issue the hedge it
+            # would have raced and discard the loser.  Query purity
+            # makes both answers bit-identical, so discarding is safe
+            # by construction (asserted, not assumed).
+            self._breaker_failure(tier)
+            hedged_values = self._hedge(t, qs)
+            if not np.array_equal(
+                hedged_values, values, equal_nan=True
+            ):  # pragma: no cover - purity violation
+                raise SketchError(
+                    "hedge dispatch disagreed with its primary: query"
+                    " purity violated"
+                )
+            return tier, values, True
+        self._breaker_success(tier)
+        return tier, values, False
+
+    def _breaker_success(self, tier: str) -> None:
+        b = self._breakers.get(tier)
+        if b is not None:
+            b.record_success()
+
+    def _fused_quantile(self, spec):
+        fn = self._fused_jits.get(spec)
+        if fn is None:
+            import functools
+
+            import jax
+
+            from sketches_tpu.batched import quantile
+
+            fn = jax.jit(functools.partial(quantile, spec))
+            self._fused_jits[spec] = fn
+        return fn
+
+    def _dispatch_group(
+        self, tenants: List[_Tenant], qs: Tuple[float, ...]
+    ) -> Tuple[str, List[np.ndarray], bool]:
+        """Cross-tenant fused dispatch: stack the group's states and
+        answer every tenant in ONE device call (the ``xla``-tier pure
+        quantile -- the floor, so no breaker applies) ->
+        ``(tier, per-tenant values, hedged)``.  Injected stragglers
+        hedge by re-running the same pure dispatch."""
+        import jax
+        import jax.numpy as jnp
+
+        states = [t.facade.state for t in tenants]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *states
+        )
+        fn = self._fused_quantile(tenants[0].facade.spec)
+        qs_arr = jnp.asarray(qs)
+        hedged = False
+        try:
+            if faults._ACTIVE:
+                faults.inject(faults.SERVE_STRAGGLER, tier=_FLOOR_TIER)
+            out = np.asarray(fn(stacked, qs_arr))
+        except SketchError:
+            if not self._hedge_enabled:
+                raise
+            self._stats["hedges"] += 1
+            resilience.bump("serve.hedges")
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("serve.hedges", tier=_FLOOR_TIER)
+            out = np.asarray(fn(stacked, qs_arr))
+            hedged = True
+        rows: List[np.ndarray] = []
+        lo = 0
+        for t in tenants:
+            hi = lo + t.facade.n_streams
+            rows.append(out[lo:hi])
+            lo = hi
+        return _FLOOR_TIER, rows, hedged
+
+    # -- flush ------------------------------------------------------------
+
+    def flush(self) -> Dict[int, ServeResult]:
+        """Drain the admission queue and answer every admitted request
+        -> ``{ticket id: result}`` (tickets' ``result`` fields are
+        filled too).
+
+        Requests fold per tenant into one fused multi-quantile dispatch
+        (the union of their quantiles); tenants sharing a spec fold
+        further into one stacked cross-tenant device call.  Requests
+        within ``floor_margin_s`` of their deadline force the floor
+        tier; answers landing past a deadline are returned but counted
+        (``serve.deadline_misses``).  An empty queue returns ``{}``.
+        Dispatch failures below the hedge/ladder floor re-raise.
+        """
+        with self._lock:
+            batch, self._queue = self._queue, []
+            self._pending_per_tenant = {}
+            if telemetry._ACTIVE:
+                telemetry.gauge_set("serve.queue_depth", 0)
+            if not batch:
+                return {}
+            # Fold requests per tenant: one fused dispatch each.
+            per_tenant: Dict[str, List[Ticket]] = {}
+            for tk in batch:
+                per_tenant.setdefault(tk.tenant, []).append(tk)
+            plans: List[Tuple[_Tenant, Tuple[float, ...], List[Ticket], bool]] = []
+            now = self._clock()
+            for name, tickets in per_tenant.items():
+                t = self._tenant(name)
+                union = tuple(sorted({q for tk in tickets for q in tk.qs}))
+                near = any(
+                    tk.deadline - now < self.config.floor_margin_s
+                    for tk in tickets
+                )
+                plans.append((t, union, tickets, near))
+            # Tenants sharing (spec, quantile union, no floor forcing
+            # needed -- the fused path IS the floor) stack into one
+            # cross-tenant device dispatch.
+            groups: Dict[Any, List[int]] = {}
+            for i, (t, union, _tks, _near) in enumerate(plans):
+                groups.setdefault((t.facade.spec, union), []).append(i)
+            out: Dict[int, ServeResult] = {}
+            for key, idxs in groups.items():
+                _spec, union = key
+                t0 = self._clock()
+                if len(idxs) > 1:
+                    tenants = [plans[i][0] for i in idxs]
+                    tier, rows, hedged = self._dispatch_group(tenants, union)
+                    self._stats["fused_dispatches"] += 1
+                    results = list(zip(idxs, rows))
+                else:
+                    i = idxs[0]
+                    t, union, _tks, near = plans[i]
+                    tier, values, hedged = self._dispatch_tenant(
+                        t, union, force_floor=near
+                    )
+                    results = [(i, values)]
+                self._stats["dispatches"] += 1
+                if telemetry._ACTIVE:
+                    telemetry.observe(
+                        "serve.batch_s", self._clock() - t0, tier=tier
+                    )
+                for i, values in results:
+                    t, _union, tickets, _near = plans[i]
+                    if self._cache_enabled:
+                        fp, digest = self._fingerprint(t)
+                        self._cache_put(t, union, fp, digest, values, tier)
+                    done = self._clock()
+                    cols = {q: j for j, q in enumerate(union)}
+                    for tk in tickets:
+                        sel = [cols[q] for q in tk.qs]
+                        missed = done > tk.deadline
+                        if missed:
+                            self._stats["deadline_misses"] += 1
+                            resilience.bump("serve.deadline_misses")
+                            if telemetry._ACTIVE:
+                                telemetry.counter_inc("serve.deadline_misses")
+                        tk.result = ServeResult(
+                            values=values[:, sel], tier=tier, hedged=hedged,
+                            deadline_missed=missed,
+                        )
+                        out[tk.id] = tk.result
+                        if telemetry._ACTIVE:
+                            telemetry.observe(
+                                "serve.request_s", done - tk.submitted_at,
+                                source="dispatch",
+                            )
+            return out
+
+    def query(
+        self,
+        name: str,
+        quantiles: Sequence[float],
+        deadline_s: Optional[float] = None,
+    ) -> ServeResult:
+        """Submit-and-flush convenience for the synchronous caller ->
+        the request's :class:`ServeResult`.
+
+        Shed requests raise :class:`ServeOverload`; spent budgets raise
+        :class:`DeadlineExceeded`; everything the batch path counts
+        (hedges, deadline misses, cache hits) is counted here too.
+        Concurrent callers' queued requests flush in the same pass --
+        batching is cooperative, not per-caller.
+        """
+        ticket = self.submit(name, quantiles, deadline_s)
+        if ticket.result is not None:  # cache hit at admission
+            return ticket.result
+        self.flush()
+        assert ticket.result is not None  # flush answers every admitted ticket
+        return ticket.result
+
+    # -- introspection ----------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        """Always-on serving counters (requests, shed, hedges, cache
+        hits/misses/poisoned, deadline misses, dispatches, breaker
+        trips) -- a copy; zeros mean nothing failed yet.  The armed
+        telemetry layer mirrors these under the declared ``serve.*``
+        metric names."""
+        with self._lock:
+            out = dict(self._stats)
+            out["queue_depth"] = len(self._queue)
+            out["tenants"] = len(self._tenants)
+            out["cache_entries"] = len(self._cache)
+            return out

@@ -210,6 +210,35 @@ _DECLARED = (
     Metric("accuracy.collapsed_mass_frac", "gauge", "sketches_tpu.accuracy",
            "Fraction of a watched stream's mass clamped into the window"
            " edge bins at the most recent audit (label: stream)."),
+    Metric("serve.requests", "counter", "sketches_tpu.serve",
+           "Quantile requests submitted to the serving tier (admitted,"
+           " cached, and shed alike)."),
+    Metric("serve.shed", "counter", "sketches_tpu.serve",
+           "Requests refused at admission (label: reason --"
+           " queue_depth/tenant_quota/injected)."),
+    Metric("serve.deadline_misses", "counter", "sketches_tpu.serve",
+           "Requests answered (or refused) after their deadline budget"
+           " was already spent."),
+    Metric("serve.hedges", "counter", "sketches_tpu.serve",
+           "Hedged dispatches issued for straggling/failed primary"
+           " query dispatches (label: tier)."),
+    Metric("serve.cache.hits", "counter", "sketches_tpu.serve",
+           "Queries answered from the fingerprint-keyed result cache."),
+    Metric("serve.cache.misses", "counter", "sketches_tpu.serve",
+           "Cache-armed queries that had to dispatch to the device."),
+    Metric("serve.cache.poisoned", "counter", "sketches_tpu.serve",
+           "Cached entries that failed re-verification against the live"
+           " fingerprint/checksum and were quarantined."),
+    Metric("serve.breaker.trips", "counter", "sketches_tpu.serve",
+           "Circuit-breaker openings per engine tier (label: tier)."),
+    Metric("serve.queue_depth", "gauge", "sketches_tpu.serve",
+           "Admission-queue depth at the most recent submit/flush."),
+    Metric("serve.request_s", "histogram", "sketches_tpu.serve",
+           "Per-request serving latency, submit to answer (label:"
+           " source -- cache/dispatch)."),
+    Metric("serve.batch_s", "histogram", "sketches_tpu.serve",
+           "Fused flush dispatch wall time per tenant group (label:"
+           " tier)."),
 )
 
 #: Every declared metric by name (static inventory + runtime
@@ -1082,6 +1111,19 @@ SLOS: Tuple[SLO, ...] = (
         total="accuracy.audits", budget=0.01, window="1h",
         doc="<=1% of shadow audits may breach the alpha contract"
         " (UDDSketch's silent-collapse failure mode, gated).",
+    ),
+    SLO(
+        "serve-shed", "ratio", "serve.shed", total="serve.requests",
+        budget=0.05, window="1h",
+        doc="<=5% of serving requests shed at admission: shedding is the"
+        " declared overload valve, but sustained shedding means the"
+        " fleet is undersized, not protected.",
+    ),
+    SLO(
+        "serve-deadline", "ratio", "serve.deadline_misses",
+        total="serve.requests", budget=0.05, window="1h",
+        doc="<=5% of serving requests may miss their deadline budget"
+        " even after degrading to the cheapest engine tier.",
     ),
 )
 

@@ -20,6 +20,7 @@ _EXAMPLES = os.path.join(
         "wire_interop.py",
         "chaos_drill.py",
         "fleet_dashboard.py",
+        "serve_load.py",
     ],
 )
 def test_example_runs_clean(script):

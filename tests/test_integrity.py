@@ -542,6 +542,71 @@ def _detect_state_bitflip():
     return True
 
 
+def _serve_server():
+    from sketches_tpu import serve
+
+    srv = serve.SketchServer()
+    srv.add_tenant("t", 8, spec=SPEC)
+    rng = np.random.RandomState(30)
+    srv.ingest("t", rng.lognormal(0.0, 0.5, (8, 48)).astype(np.float32))
+    return srv
+
+
+def _detect_serve_straggler():
+    """A straggling dispatch is hedged around: the answer survives
+    bit-identical and the hedge is counted in the health ledger."""
+    srv = _serve_server()
+    direct = np.asarray(srv.tenant("t").get_quantile_values([0.5, 0.99]))
+    faults.arm(faults.SERVE_STRAGGLER, times=1)
+    try:
+        result = srv.query("t", [0.5, 0.99])
+    finally:
+        faults.disarm()
+    return (
+        result.hedged
+        and np.array_equal(result.values, direct, equal_nan=True)
+        and resilience.health()["counters"].get("serve.hedges", 0) >= 1
+    )
+
+
+def _detect_serve_queue_overflow():
+    """A forced overflow is SHED -- a structured ``ServeOverload`` with
+    the injected reason and a counted shed, never a hang or a drop."""
+    from sketches_tpu.resilience import ServeOverload
+
+    srv = _serve_server()
+    faults.arm(faults.SERVE_QUEUE_OVERFLOW, times=1)
+    try:
+        srv.query("t", [0.5])
+        return False  # the forced overflow was admitted
+    except ServeOverload as e:
+        return (
+            e.reason == "injected"
+            and resilience.health()["counters"].get("serve.shed", 0) >= 1
+        )
+    finally:
+        faults.disarm()
+
+
+def _detect_serve_cache_poison():
+    """A poisoned cache entry fails re-verification, is quarantined and
+    counted, and the request recomputes the exact answer."""
+    srv = _serve_server()
+    srv.query("t", [0.9])  # fill the (fingerprint, q) entry
+    direct = np.asarray(srv.tenant("t").get_quantile_values([0.9]))
+    faults.arm(faults.SERVE_CACHE_POISON, times=1)
+    try:
+        result = srv.query("t", [0.9])
+    finally:
+        faults.disarm()
+    return (
+        not result.cached  # the hit was refused, not served
+        and np.array_equal(result.values, direct, equal_nan=True)
+        and srv.stats()["cache_poisoned"] == 1
+        and resilience.health()["counters"].get("serve.cache_poisoned", 0) >= 1
+    )
+
+
 #: Every injectable site maps to a detector proof -- the closure the
 #: satellite task demands: no silently undetectable fault site.
 _SITE_DETECTORS = {
@@ -552,6 +617,9 @@ _SITE_DETECTORS = {
     faults.CHECKPOINT_WRITE: _detect_checkpoint_write,
     faults.MESH_SHARD: _detect_mesh_shard,
     faults.STATE_BITFLIP: _detect_state_bitflip,
+    faults.SERVE_STRAGGLER: _detect_serve_straggler,
+    faults.SERVE_QUEUE_OVERFLOW: _detect_serve_queue_overflow,
+    faults.SERVE_CACHE_POISON: _detect_serve_cache_poison,
 }
 
 

