@@ -52,12 +52,12 @@ N_BATCHES = 8
 QS = [0.5, 0.9, 0.99]
 
 
-def run_shard(shard: int, outdir: str) -> None:
+def run_shard(shard: int, outdir: str, trace_path=None) -> None:
     """One fleet shard: warm up, arm the observability layers, run the
     workload, write the snapshot artifact."""
     import numpy as np
 
-    from sketches_tpu import accuracy, profiling, telemetry
+    from sketches_tpu import accuracy, profiling, telemetry, tracing
     from sketches_tpu.batched import BatchedDDSketch, SketchSpec
     from sketches_tpu.pb import wire
 
@@ -88,11 +88,18 @@ def run_shard(shard: int, outdir: str) -> None:
     accuracy.enable()
     accuracy.reset()
     accuracy.watch(sk, f"shard{shard}", streams=(0, 1, 2, 3), interval=4)
+    # Deterministic per-shard trace ids: the merged exemplars below name
+    # the same traces every run (no-op when the recorder is disarmed).
+    tracing.seed_ids(1000 + shard)
 
     for _ in range(N_BATCHES):
         vals = rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32)
-        sk.add(vals)
-        sk.get_quantile_values(QS)
+        # One trace per tick: the ingest+query spans (and their histogram
+        # exemplars) link to it, so the merged p99 answers with trace ids.
+        ctx = tracing.new_trace() if tracing.enabled() else None
+        with tracing.use(ctx):
+            sk.add(vals)
+            sk.get_quantile_values(QS)
     other.add(rng.lognormal(3.0, 0.4, (N_STREAMS, BATCH)).astype(np.float32))
     sk.merge(other)
     blobs = wire.state_to_bytes(spec, sk.state)
@@ -103,6 +110,10 @@ def run_shard(shard: int, outdir: str) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(snap, f, indent=1, sort_keys=True)
         f.write("\n")
+    if trace_path:
+        with open(trace_path, "w", encoding="utf-8") as f:
+            json.dump(telemetry.chrome_trace(), f, indent=1, sort_keys=True)
+            f.write("\n")
     acc = accuracy.summary()
     print(
         f"shard {shard}: {int(acc['audits'])} audits,"
@@ -114,18 +125,44 @@ def _fmt_s(v) -> str:
     return "-" if v is None else f"{v * 1e3:8.3f} ms"
 
 
-def run_fleet(n_shards: int, outdir: str) -> int:
+def _fleet_chrome_trace(outdir: str, n_shards: int, path: str) -> None:
+    """Concatenate the shards' chrome traces into ONE viewer document:
+    shard ``s``'s tracks are re-homed onto pids ``s*10 + pid`` (the
+    declared per-process pid scheme stays collision-free across the
+    fleet) with the shard named in ``process_name``."""
+    events = []
+    for s in range(n_shards):
+        shard_path = os.path.join(outdir, f"trace{s}.json")
+        if not os.path.exists(shard_path):
+            continue
+        with open(shard_path, encoding="utf-8") as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = s * 10 + int(ev.get("pid", 0))
+            if ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"shard {s}: {args.get('name', '?')}"
+                ev["args"] = args
+            events.append(ev)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def run_fleet(n_shards: int, outdir: str, trace: str = None) -> int:
     """Spawn the shards, merge their snapshots, print the dashboard."""
     # Sequential shards: CI runners have two cores, and N concurrent
     # jax processes contending for them would bill scheduler noise to
     # the latency SLOs.  A real fleet's shards own their hosts.
     env = dict(os.environ)
     for s in range(n_shards):
-        rc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--worker", str(s), "--outdir", outdir],
-            env=env,
-        ).returncode
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--worker", str(s), "--outdir", outdir]
+        if trace:
+            cmd += ["--trace", os.path.join(outdir, f"trace{s}.json")]
+        rc = subprocess.run(cmd, env=env).returncode
         if rc != 0:
             print(f"fleet: shard {s} failed (rc={rc}); no merged verdict")
             return 1
@@ -172,6 +209,33 @@ def run_fleet(n_shards: int, outdir: str) -> int:
                 f" {row['x_roofline']:.0f}x above the declared roofline"
             )
 
+    if trace:
+        from sketches_tpu import tracing
+
+        _fleet_chrome_trace(outdir, n_shards, trace)
+        bundle_path = trace + ".forensics.json"
+        tracing.dump_forensics(
+            "fleet_dashboard.end_of_run",
+            detail={"shards": n_shards},
+            snapshot=merged,
+            path=bundle_path,
+        )
+        print(f"\nfleet: chrome trace -> {trace};"
+              f" forensic bundle -> {bundle_path}")
+        # The merged-exemplar drill: the trace ids behind the FLEET p99
+        # (reservoirs survived merge_snapshots; ids name shard requests).
+        try:
+            found = telemetry.exemplars_for(merged, "query_s", 0.99)
+        except Exception as e:  # noqa: BLE001 - diagnostic, not a gate
+            print(f"fleet: p99 exemplars unavailable: {e}")
+        else:
+            print(f"fleet: query_s p99 exemplar traces (bin"
+                  f" {found['bin_key']}):")
+            for ex in found["exemplars"]:
+                print(f"  trace {ex['trace_id']}  value {ex['value']:g}s")
+            if not found["exemplars"]:
+                print("  (no traced observation reached the p99 bin)")
+
     print("\n== SLO verdict ==")
     lines, burning, evaluated = telemetry.check_slo(merged)
     for line in lines:
@@ -194,15 +258,22 @@ def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--shards", type=int, default=3)
     parser.add_argument("--outdir", default=None)
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="write the fleet's combined chrome trace to"
+                        " PATH and a forensic bundle (merged snapshot +"
+                        " parent flight recorder) to PATH.forensics.json;"
+                        " prints the exemplar trace ids behind the merged"
+                        " p99")
     parser.add_argument("--worker", type=int, default=None,
                         help=argparse.SUPPRESS)
     args = parser.parse_args()
     if args.worker is not None:
-        run_shard(args.worker, args.outdir or tempfile.gettempdir())
+        run_shard(args.worker, args.outdir or tempfile.gettempdir(),
+                  trace_path=args.trace)
         return 0
     outdir = args.outdir or tempfile.mkdtemp(prefix="fleet_dashboard_")
     os.makedirs(outdir, exist_ok=True)
-    return run_fleet(args.shards, outdir)
+    return run_fleet(args.shards, outdir, trace=args.trace)
 
 
 if __name__ == "__main__":

@@ -59,12 +59,25 @@ def main() -> int:
     parser.add_argument("--snapshot", default=None, metavar="OUT",
                         help="write the telemetry snapshot JSON here"
                         " (arm with SKETCHES_TPU_TELEMETRY=1)")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="arm telemetry+tracing, write the end-of-run"
+                        " chrome trace to PATH and a flight-recorder"
+                        " forensic bundle to PATH.forensics.json, and"
+                        " print the exemplar trace ids behind the"
+                        " reported p99")
     args = parser.parse_args()
 
     import numpy as np
 
-    from sketches_tpu import serve, telemetry
+    from sketches_tpu import serve, telemetry, tracing
     from sketches_tpu.batched import SketchSpec
+
+    if args.trace:
+        # --trace implies the observability stack: telemetry arms the
+        # flight recorder with it (kill switch permitting), and the
+        # seeded id stream makes re-runs print the same trace ids.
+        telemetry.enable()
+        tracing.seed_ids(args.seed)
 
     rng = np.random.default_rng(args.seed)
     spec = SketchSpec(relative_accuracy=0.01, n_bins=128)
@@ -167,6 +180,33 @@ def main() -> int:
             f.write("\n")
         print(f"  telemetry snapshot ({'armed' if telemetry_armed else 'idle'})"
               f" -> {args.snapshot}")
+
+    if args.trace:
+        with open(args.trace, "w", encoding="utf-8") as f:
+            json.dump(telemetry.chrome_trace(), f, indent=1, sort_keys=True)
+            f.write("\n")
+        bundle_path = args.trace + ".forensics.json"
+        tracing.dump_forensics(
+            "serve_load.end_of_run",
+            detail={"ops": args.ops, "seed": args.seed},
+            path=bundle_path,
+        )
+        print(f"  chrome trace      -> {args.trace}")
+        print(f"  forensic bundle   -> {bundle_path}"
+              f"  (explain: python -m sketches_tpu.tracing --explain"
+              f" {bundle_path} TRACE_ID)")
+        # The exemplar drill: which requests sit behind the p99 we just
+        # reported?  (Reservoirs hold traced observations only, so an
+        # empty answer means no request landed near that bin.)
+        found = telemetry.exemplars_for(
+            telemetry.snapshot(), "serve.request_s", 0.99
+        )
+        print(f"  p99 exemplars     serve.request_s bin {found['bin_key']}"
+              f" (~{0.0 if found['bin_value'] is None else found['bin_value']:g}s)")
+        for ex in found["exemplars"]:
+            print(f"    trace {ex['trace_id']}  value {ex['value']:g}s")
+        if not found["exemplars"]:
+            print("    (no traced observation reached the p99 neighborhood)")
 
     # The driver doubles as a gate: the declared serving SLO budgets
     # (telemetry.SLOS serve-shed / serve-deadline) are 5% each.

@@ -22,6 +22,7 @@ from sketches_tpu import (
     resilience,
     serve,
     telemetry,
+    tracing,
 )
 from sketches_tpu.ddsketch import (
     BaseDDSketch,
@@ -64,7 +65,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.11.0"
+__version__ = "0.12.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -100,6 +101,9 @@ __all__ = [
     "integrity",
     # Serving tier (admission control, deadlines, hedging, result cache)
     "serve",
+    # Request tracing + flight recorder (trace contexts, exemplars,
+    # forensic bundles)
+    "tracing",
     "ServeOverload",
     "DeadlineExceeded",
     "IntegrityError",

@@ -36,6 +36,7 @@ __all__ = [
     "ACCURACY_AUDIT",
     "SERVE_CACHE",
     "SERVE_HEDGE",
+    "FLIGHT_RECORDER",
     "REGISTRY",
     "declared",
     "get",
@@ -158,6 +159,19 @@ SERVE_CACHE = EnvVar(
     ),
 )
 
+#: Flight-recorder / request-tracing kill switch (``sketches_tpu.tracing``).
+FLIGHT_RECORDER = EnvVar(
+    name="SKETCHES_TPU_FLIGHT_RECORDER",
+    default="1",
+    owner="sketches_tpu.tracing",
+    doc=(
+        "Set to 0 to keep the flight recorder and request tracing"
+        " disarmed even while telemetry is armed (no trace contexts, no"
+        " event ring, no forensic dumps); any other value arms them"
+        " together with the telemetry layer."
+    ),
+)
+
 #: Serving-tier hedged-retry kill switch (``sketches_tpu.serve``).
 SERVE_HEDGE = EnvVar(
     name="SKETCHES_TPU_SERVE_HEDGE",
@@ -177,7 +191,7 @@ REGISTRY: Dict[str, EnvVar] = {
     v.name: v
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
-        ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE,
+        ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, FLIGHT_RECORDER,
     )
 }
 

@@ -52,7 +52,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
+from sketches_tpu import (
+    accuracy,
+    faults,
+    integrity,
+    profiling,
+    resilience,
+    telemetry,
+    tracing,
+)
 from sketches_tpu.mapping import KeyMapping, mapping_from_name
 from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
 from sketches_tpu.resilience import SketchValueError, SpecError
@@ -1211,6 +1219,10 @@ class BatchedDDSketch:
                 "ingest_s", _t0, component="batched", engine=_eng
             )
             telemetry.counter_inc("batched.ingest_batches")
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "engine.ingest", engine=_eng, component="batched"
+            )
         # Device-clocked attribution AFTER the host span closes: the
         # telemetry span keeps measuring submission, the profiling
         # record blocks for execution.
@@ -1392,6 +1404,12 @@ class BatchedDDSketch:
                     )
                 if _p0 is not None:
                     profiling.record("query", tier, _p0, out)
+                if tracing._ACTIVE:
+                    # The resolved rung, on the request's trace: the
+                    # forensic answer to "which engine actually served".
+                    tracing.record_event(
+                        "engine.query", tier=tier, component="batched"
+                    )
                 return tier, out
             except Exception as e:
                 if not self._demote_query(tier, e):

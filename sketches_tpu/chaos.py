@@ -58,7 +58,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry, tracing
 from sketches_tpu.resilience import (
     CheckpointCorrupt,
     InjectedFault,
@@ -337,6 +337,25 @@ _FAULT_DRIVERS = {
 }
 
 
+def _classify_forensics(site: str, outcome: str, step: int) -> None:
+    """Every fault classification dumps a forensic bundle while the
+    flight recorder is armed: the bundle's triggering trace is the most
+    recent request trace (the serve campaign's in-flight request; the
+    core campaign runs untraced ops, so its bundles carry recorder
+    events without a trigger trace).  Disarmed this is one bool test;
+    a dump failure is swallowed -- forensics never fail a campaign."""
+    if not tracing._ACTIVE:
+        return
+    try:
+        tracing.dump_forensics(
+            f"chaos.{site}",
+            trace=tracing.last_trace(),
+            detail={"site": site, "outcome": outcome, "step": step},
+        )
+    except Exception:  # noqa: BLE001 - forensics must not fail the soak
+        pass
+
+
 # ---------------------------------------------------------------------------
 # Campaign
 # ---------------------------------------------------------------------------
@@ -396,6 +415,7 @@ def run_campaign(
                     c.errors.append(f"step {step} site {site}: {e!r}")
                 if outcome != "skipped":
                     _event(c, step, site, outcome)
+                    _classify_forensics(site, outcome, step)
         # Final audit: the fold conserves every ingested value.
         final = float(np.asarray(_fold(c).count, np.float64).sum())
         conserved = abs(final - c.expected_count) <= max(
@@ -425,6 +445,7 @@ def run_campaign(
             "expected_count": c.expected_count,
             "final_count": final,
             "integrity_reports": len(integrity.reports()),
+            "forensics": tracing.stats() if tracing.enabled() else None,
             "health": resilience.health(),
             # The end-of-campaign telemetry snapshot rides the verdict
             # when the metrics layer is armed (the CI chaos job), so the
@@ -609,6 +630,7 @@ def run_serve_campaign(steps: int, seed: int) -> Dict[str, Any]:
                 errors.append(f"step {step} site {site}: {e!r}")
             if outcome != "skipped":
                 events.append({"step": step, "site": site, "outcome": outcome})
+                _classify_forensics(site, outcome, step)
     # Mass audit: every ingested value is still in its tenant's sketch.
     conserved = True
     for name in _SERVE_TENANTS:
@@ -639,6 +661,9 @@ def run_serve_campaign(steps: int, seed: int) -> Dict[str, Any]:
         "serve_stats": server.stats(),
         "health": resilience.health(),
         "telemetry": telemetry.snapshot() if telemetry.enabled() else None,
+        # Recorder accounting rides the verdict when armed (None when
+        # the layer is absent, matching the telemetry convention).
+        "forensics": tracing.stats() if tracing.enabled() else None,
     }
 
 
@@ -674,6 +699,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--out", default=None, metavar="PATH",
         help="write the verdict JSON here (stdout always gets a summary)",
     )
+    parser.add_argument(
+        "--forensics", default=None, metavar="PATH",
+        help="write the campaign's most recent forensic bundle here"
+        " (requires the flight recorder armed, i.e."
+        " SKETCHES_TPU_TELEMETRY=1; explain it with"
+        " python -m sketches_tpu.tracing --explain PATH trigger)",
+    )
     parser.add_argument("--platform", default="cpu")
     args = parser.parse_args(argv)
 
@@ -690,6 +722,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(verdict, f, indent=1, sort_keys=True)
             f.write("\n")
+    if args.forensics:
+        bundle = tracing.last_bundle()
+        if bundle is not None:
+            with open(args.forensics, "w", encoding="utf-8") as f:
+                json.dump(bundle, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"chaos: forensic bundle -> {args.forensics}")
+        else:
+            print(
+                "chaos: no forensic bundle recorded (flight recorder"
+                " disarmed? arm with SKETCHES_TPU_TELEMETRY=1)"
+            )
     print(
         f"chaos: {verdict['steps']} steps, seed {verdict['seed']},"
         f" {verdict['n_faults']} faults injected, outcomes"

@@ -56,6 +56,7 @@ import contextlib
 import threading
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
+from sketches_tpu import tracing
 from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import InjectedFault, SpecError, bump
 
@@ -234,6 +235,13 @@ def inject(site: str, payload=None, index: Optional[int] = None, tier=None):
         return payload
     plan.fired += 1
     bump("faults." + site)
+    if tracing._ACTIVE:
+        # Injected faults are exactly the events a forensic bundle must
+        # carry: the adversary's move, on the victim request's trace.
+        tracing.record_event(
+            "fault.injected", site=site, mode=plan.mode,
+            tier=str(tier) if tier is not None else None,
+        )
     if plan.mode == "raise":
         if plan.exc is not None:
             raise plan.exc
@@ -349,6 +357,10 @@ def cache_poison_flip(n_bytes: int) -> Optional[Tuple[int, int]]:
     bit = (h >> 24) % 8
     plan.fired += 1
     bump("faults." + SERVE_CACHE_POISON)
+    if tracing._ACTIVE:
+        tracing.record_event(
+            "fault.injected", site=SERVE_CACHE_POISON, byte=byte, bit=bit
+        )
     return (byte, bit)
 
 

@@ -611,6 +611,17 @@ def _record(report: IntegrityReport, errors: Optional[str]) -> IntegrityReport:
         telemetry.counter_inc(
             "integrity.violations", float(report.n_violations)
         )
+    # Flight-recorder feed: an integrity violation is forensic evidence
+    # by definition (lazy import -- integrity loads below tracing).
+    from sketches_tpu import tracing
+
+    if tracing._ACTIVE:
+        tracing.record_event(
+            "integrity.violation", seam=report.seam,
+            n_violations=report.n_violations,
+            first=str(report.violations[0].invariant) if report.violations
+            else None,
+        )
     with _lock:
         if len(_reports) < _MAX_REPORTS:
             _reports.append(report)

@@ -32,7 +32,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sketches_tpu import accuracy, faults, integrity, profiling, resilience, telemetry
+from sketches_tpu import (
+    accuracy,
+    faults,
+    integrity,
+    profiling,
+    resilience,
+    telemetry,
+    tracing,
+)
 from sketches_tpu.batched import (
     BatchedDDSketch,
     SketchSpec,
@@ -642,6 +650,10 @@ class DistributedDDSketch:
                 telemetry.finish_span("distributed.fold_s", _t0)
             if _p0 is not None:
                 profiling.record("fold", "psum", _p0, self._merged_cache)
+            if tracing._ACTIVE:
+                tracing.record_event(
+                    "engine.fold", tier="psum", component="distributed"
+                )
             if integrity._ACTIVE:
                 # Parallel checksum lane over the psum fold: the shard
                 # fingerprints must sum to the folded fingerprint.
@@ -873,6 +885,10 @@ class DistributedDDSketch:
                     )
                 if _p0 is not None:
                     profiling.record("query", tier, _p0, out)
+                if tracing._ACTIVE:
+                    tracing.record_event(
+                        "engine.query", tier=tier, component="distributed"
+                    )
                 return out
             except Exception as e:
                 nxt = resilience.demote_query_tier(self._query_disabled, tier)

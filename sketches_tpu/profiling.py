@@ -22,7 +22,8 @@ decode).  Three surfaces:
   measured calls/time fold by sum, fleet-wide device-time percentiles
   come from the ``profiling.device_s`` histogram this layer feeds).
 * ``telemetry.chrome_trace()`` -- armed dispatches append ``X`` events
-  on a second process track (pid 2, one thread per engine tier): the
+  on a second process track (``telemetry.CHROME_PID_DEVICE``, one
+  thread per engine tier -- the declared collision-free pid scheme): the
   device timeline next to the host spans in one viewer.
 
 Arming: OFF by default.  ``SKETCHES_TPU_PROFILING=1`` (declared in
@@ -200,7 +201,7 @@ def record(phase: str, tier: str, t0: float, sync: Any = None) -> float:
                     "ph": "X",
                     "ts": (t0 - telemetry._epoch_pc) * 1e6,
                     "dur": dur * 1e6,
-                    "pid": 2,
+                    "pid": telemetry.CHROME_PID_DEVICE,
                     "tid": tid,
                     "args": {"phase": phase, "tier": tier},
                 }
@@ -222,7 +223,7 @@ def chrome_events() -> List[dict]:
         {
             "name": "process_name",
             "ph": "M",
-            "pid": 2,
+            "pid": telemetry.CHROME_PID_DEVICE,
             "args": {"name": "sketches_tpu device (profiling)"},
         }
     ]
@@ -231,7 +232,7 @@ def chrome_events() -> List[dict]:
             {
                 "name": "thread_name",
                 "ph": "M",
-                "pid": 2,
+                "pid": telemetry.CHROME_PID_DEVICE,
                 "tid": tid,
                 "args": {"name": f"tier-{tier}"},
             }

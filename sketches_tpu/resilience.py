@@ -234,6 +234,15 @@ def record_downgrade(
             "resilience.downgrade",
             component=component, from_tier=from_tier, to_tier=to_tier,
         )
+    # The flight recorder's ladder-downgrade feed (one bool test when
+    # disarmed; lazy import -- tracing imports this module's taxonomy).
+    from sketches_tpu import tracing
+
+    if tracing._ACTIVE:
+        tracing.record_event(
+            "resilience.downgrade", component=component,
+            from_tier=from_tier, to_tier=to_tier, reason=str(reason)[:200],
+        )
     return ev
 
 

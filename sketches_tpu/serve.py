@@ -48,6 +48,17 @@ answer in ONE device dispatch -- wrapped in a full robustness envelope:
   request silently recomputes -- a poisoned cache degrades to a cache
   miss, never to a wrong answer.
 
+Tracing (r13): with the flight recorder armed
+(``sketches_tpu.tracing``, always-on when telemetry is), every request
+roots a :class:`~sketches_tpu.tracing.TraceContext` at admission
+(``ticket.trace``); cache hit/miss/poison, shed, deadline, hedge, and
+breaker decisions become recorder events on that trace; each fused
+dispatch binds a child context so the resolved engine-tier span (and
+the fold/serde spans under it) link causally; the per-request latency
+observation carries the trace as a histogram exemplar -- so "the p99
+bin" answers with trace ids.  Cache poison and unexpected admission
+errors auto-dump forensic bundles (``tracing.dump_forensics``).
+
 Determinism: the serving clock is injectable (``clock=`` -- defaults to
 ``telemetry.clock``), so deadline/hedge/breaker behavior replays
 exactly under a virtual clock; no code here sleeps or reads wall time
@@ -73,7 +84,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from sketches_tpu import faults, integrity, resilience, telemetry
+from sketches_tpu import faults, integrity, resilience, telemetry, tracing
 from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import (
     QUERY_LADDER,
@@ -142,7 +153,10 @@ class Ticket:
     ``deadline`` is absolute serving-clock seconds; ``result`` is
     filled by the admission cache hit or the next :meth:`flush` --
     ``None`` until then.  A shed request never gets a ticket (admission
-    raises instead).
+    raises instead).  ``trace`` is the request's root
+    :class:`~sketches_tpu.tracing.TraceContext` (None while the flight
+    recorder is disarmed): the id that links this request to its span
+    events, histogram exemplars, and forensic bundles.
     """
 
     id: int
@@ -151,6 +165,7 @@ class Ticket:
     deadline: float
     submitted_at: float
     result: Optional["ServeResult"] = None
+    trace: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -332,6 +347,8 @@ class SketchServer:
             t.facade.add(values, weights)
             t.version += 1
             t.fp_cache = None
+            if tracing._ACTIVE:
+                tracing.record_event("serve.write", tenant=name, op="ingest")
 
     def merge(self, name: str, other) -> None:
         """Fold another ``BatchedDDSketch`` into tenant ``name`` (write
@@ -342,6 +359,8 @@ class SketchServer:
             t.facade.merge(other)
             t.version += 1
             t.fp_cache = None
+            if tracing._ACTIVE:
+                tracing.record_event("serve.write", tenant=name, op="merge")
 
     def invalidate(self, name: str) -> None:
         """Drop tenant ``name``'s memoized fingerprint after an
@@ -367,11 +386,12 @@ class SketchServer:
         return fp, digest
 
     def _cache_get(
-        self, t: _Tenant, qs: Tuple[float, ...]
+        self, t: _Tenant, qs: Tuple[float, ...], ctx=None
     ) -> Optional[np.ndarray]:
         """Cache lookup with poison detection -> values (a defensive
         copy) or None.  A hit is re-verified (live fingerprint + payload
-        checksum); a mismatch quarantines the entry, counts it, and
+        checksum); a mismatch quarantines the entry, counts it, dumps a
+        forensic bundle naming the poisoned entry (recorder armed), and
         reads as a miss -- the request recomputes."""
         fp, digest = self._fingerprint(t)
         key = (t.name, digest, qs)
@@ -391,7 +411,7 @@ class SketchServer:
         )
         sum_ok = entry.checksum == _payload_checksum(entry.fp, entry.values)
         if not (live_ok and sum_ok):
-            self._quarantine(key)
+            self._quarantine(key, ctx=ctx)
             return None
         # LRU touch.
         try:
@@ -401,7 +421,7 @@ class SketchServer:
         self._cache_order.append(key)
         return entry.values.copy()
 
-    def _quarantine(self, key) -> None:
+    def _quarantine(self, key, ctx=None) -> None:
         self._cache.pop(key, None)
         try:
             self._cache_order.remove(key)
@@ -411,6 +431,20 @@ class SketchServer:
         resilience.bump("serve.cache_poisoned")
         if telemetry._ACTIVE:
             telemetry.counter_inc("serve.cache.poisoned")
+        if tracing._ACTIVE:
+            # A poisoned cache entry is silent-corruption evidence: name
+            # the entry and dump the forensic picture around it.
+            entry_name = {
+                "tenant": key[0],
+                "quantiles": ",".join(f"{q:g}" for q in key[2]),
+                "fingerprint": key[1].hex()[:16],
+            }
+            tracing.record_event(
+                "serve.cache.poisoned", ctx=ctx, **entry_name
+            )
+            tracing.dump_forensics(
+                "serve.cache_poison", trace=ctx, detail=entry_name
+            )
 
     def _cache_put(
         self, t: _Tenant, qs: Tuple[float, ...], fp: np.ndarray,
@@ -426,11 +460,15 @@ class SketchServer:
 
     # -- admission --------------------------------------------------------
 
-    def _shed(self, tenant: str, reason: str) -> None:
+    def _shed(self, tenant: str, reason: str, ctx=None) -> None:
         self._stats["shed"] += 1
         resilience.bump("serve.shed")
         if telemetry._ACTIVE:
             telemetry.counter_inc("serve.shed", reason=reason)
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "serve.shed", ctx=ctx, tenant=tenant, reason=reason
+            )
         raise ServeOverload(
             f"request for tenant {tenant!r} shed at admission ({reason})",
             reason=reason, tenant=tenant,
@@ -451,59 +489,104 @@ class SketchServer:
         :class:`ServeOverload` (structured ``reason``); a deadline
         budget that is already non-positive raises
         :class:`DeadlineExceeded`.  Admitted requests are never
-        evicted; :meth:`flush` answers them.
+        evicted; :meth:`flush` answers them.  With the flight recorder
+        armed every request roots a trace context (``ticket.trace``)
+        and its admission decisions become recorder events; a
+        ``SketchError`` escaping admission that is NOT one of the two
+        structured refusals auto-dumps a forensic bundle before
+        re-raising.
         """
         qs = tuple(sorted(float(q) for q in quantiles))
         if not qs:
             raise SketchValueError("a request needs at least one quantile")
+        try:
+            return self._submit_admitted(name, qs, deadline_s)
+        except (ServeOverload, DeadlineExceeded):
+            raise  # the structured refusals: handled, not forensic
+        except SketchError as e:
+            if tracing._ACTIVE:
+                tracing.dump_forensics(
+                    "serve.submit",
+                    detail={"tenant": name, "error": repr(e)},
+                )
+            raise
+
+    def _submit_admitted(
+        self,
+        name: str,
+        qs: Tuple[float, ...],
+        deadline_s: Optional[float],
+    ) -> Ticket:
+        """:meth:`submit` body (admission under the lock); split out so
+        the caller can wrap it in the forensic-dump net.  Raises exactly
+        as :meth:`submit` documents."""
         with self._lock:
             t = self._tenant(name)
             self._stats["requests"] += 1
             now = self._clock()
+            _trc = tracing.new_trace() if tracing._ACTIVE else None
             if telemetry._ACTIVE:
                 telemetry.counter_inc("serve.requests")
             budget = (
                 self.config.default_deadline_s
                 if deadline_s is None else float(deadline_s)
             )
+            if _trc is not None:
+                tracing.record_event(
+                    "serve.submit", ctx=_trc, tenant=name,
+                    qs=",".join(f"{q:g}" for q in qs), budget_s=budget,
+                )
             if budget <= 0:
                 self._stats["deadline_misses"] += 1
                 resilience.bump("serve.deadline_misses")
                 if telemetry._ACTIVE:
                     telemetry.counter_inc("serve.deadline_misses")
+                if _trc is not None:
+                    tracing.record_event(
+                        "serve.deadline_spent", ctx=_trc, tenant=name,
+                        budget_s=budget,
+                    )
                 raise DeadlineExceeded(
                     f"request for tenant {name!r} arrived with a spent"
                     f" deadline budget ({budget:g}s)"
                 )
             ticket = Ticket(
                 id=self._next_id, tenant=name, qs=qs,
-                deadline=now + budget, submitted_at=now,
+                deadline=now + budget, submitted_at=now, trace=_trc,
             )
             self._next_id += 1
             if self._cache_enabled:
-                values = self._cache_get(t, qs)
+                values = self._cache_get(t, qs, ctx=_trc)
                 if values is not None:
                     self._stats["cache_hits"] += 1
+                    if _trc is not None:
+                        tracing.record_event(
+                            "serve.cache.hit", ctx=_trc, tenant=name
+                        )
                     if telemetry._ACTIVE:
                         telemetry.counter_inc("serve.cache.hits")
                         telemetry.observe(
                             "serve.request_s", self._clock() - now,
-                            source="cache",
+                            trace=_trc, source="cache",
                         )
                     ticket.result = ServeResult(values=values, tier="cache")
                     return ticket
                 self._stats["cache_misses"] += 1
+                if _trc is not None:
+                    tracing.record_event(
+                        "serve.cache.miss", ctx=_trc, tenant=name
+                    )
                 if telemetry._ACTIVE:
                     telemetry.counter_inc("serve.cache.misses")
             if faults._ACTIVE:
                 try:
                     faults.inject(faults.SERVE_QUEUE_OVERFLOW)
                 except SketchError:
-                    self._shed(name, "injected")
+                    self._shed(name, "injected", ctx=_trc)
             if self._pending_per_tenant.get(name, 0) >= self.config.tenant_quota:
-                self._shed(name, "tenant_quota")
+                self._shed(name, "tenant_quota", ctx=_trc)
             if len(self._queue) >= self.config.max_queue_depth:
-                self._shed(name, "queue_depth")
+                self._shed(name, "queue_depth", ctx=_trc)
             self._queue.append(ticket)
             self._pending_per_tenant[name] = (
                 self._pending_per_tenant.get(name, 0) + 1
@@ -540,6 +623,10 @@ class SketchServer:
             )
             if telemetry._ACTIVE:
                 telemetry.counter_inc("serve.breaker.trips", tier=tier)
+            if tracing._ACTIVE:
+                tracing.record_event(
+                    "serve.breaker", tier=tier, state="open"
+                )
 
     def _blocked_tiers(self) -> frozenset:
         blocked = set()
@@ -556,6 +643,8 @@ class SketchServer:
         resilience.bump("serve.hedges")
         if telemetry._ACTIVE:
             telemetry.counter_inc("serve.hedges", tier=_FLOOR_TIER)
+        if tracing._ACTIVE:
+            tracing.record_event("serve.hedge", tier=_FLOOR_TIER)
         _, values = t.facade.get_quantile_values_resolved(
             qs, disabled_tiers=_BREAKABLE_TIERS
         )
@@ -661,6 +750,8 @@ class SketchServer:
             resilience.bump("serve.hedges")
             if telemetry._ACTIVE:
                 telemetry.counter_inc("serve.hedges", tier=_FLOOR_TIER)
+            if tracing._ACTIVE:
+                tracing.record_event("serve.hedge", tier=_FLOOR_TIER)
             out = np.asarray(fn(stacked, qs_arr))
             hedged = True
         rows: List[np.ndarray] = []
@@ -717,22 +808,42 @@ class SketchServer:
             for key, idxs in groups.items():
                 _spec, union = key
                 t0 = self._clock()
-                if len(idxs) > 1:
-                    tenants = [plans[i][0] for i in idxs]
-                    tier, rows, hedged = self._dispatch_group(tenants, union)
-                    self._stats["fused_dispatches"] += 1
-                    results = list(zip(idxs, rows))
-                else:
-                    i = idxs[0]
-                    t, union, _tks, near = plans[i]
-                    tier, values, hedged = self._dispatch_tenant(
-                        t, union, force_floor=near
+                # Dispatch under a child of the first traced ticket's
+                # context, so the engine-tier span (and the psum fold /
+                # wire spans under it) link into the request's trace.
+                _dctx = None
+                if tracing._ACTIVE:
+                    _primary = next(
+                        (tk.trace for i in idxs for tk in plans[i][2]
+                         if tk.trace is not None),
+                        None,
                     )
-                    results = [(i, values)]
+                    if _primary is not None:
+                        _dctx = tracing.child_span(_primary)
+                _tok = tracing.bind(_dctx) if _dctx is not None else None
+                try:
+                    if len(idxs) > 1:
+                        tenants = [plans[i][0] for i in idxs]
+                        tier, rows, hedged = self._dispatch_group(
+                            tenants, union
+                        )
+                        self._stats["fused_dispatches"] += 1
+                        results = list(zip(idxs, rows))
+                    else:
+                        i = idxs[0]
+                        t, union, _tks, near = plans[i]
+                        tier, values, hedged = self._dispatch_tenant(
+                            t, union, force_floor=near
+                        )
+                        results = [(i, values)]
+                finally:
+                    if _tok is not None:
+                        tracing.unbind(_tok)
                 self._stats["dispatches"] += 1
                 if telemetry._ACTIVE:
                     telemetry.observe(
-                        "serve.batch_s", self._clock() - t0, tier=tier
+                        "serve.batch_s", self._clock() - t0, trace=_dctx,
+                        tier=tier,
                     )
                 for i, values in results:
                     t, _union, tickets, _near = plans[i]
@@ -754,10 +865,25 @@ class SketchServer:
                             deadline_missed=missed,
                         )
                         out[tk.id] = tk.result
+                        if tk.trace is not None and tracing._ACTIVE:
+                            tracing.record_event(
+                                "serve.dispatch", ctx=tk.trace,
+                                tenant=tk.tenant, tier=tier, hedged=hedged,
+                                fused=len(idxs) > 1,
+                                dispatch_span=(
+                                    _dctx.span_hex if _dctx is not None
+                                    else None
+                                ),
+                            )
+                            if missed:
+                                tracing.record_event(
+                                    "serve.deadline_miss", ctx=tk.trace,
+                                    tenant=tk.tenant,
+                                )
                         if telemetry._ACTIVE:
                             telemetry.observe(
                                 "serve.request_s", done - tk.submitted_at,
-                                source="dispatch",
+                                trace=tk.trace, source="dispatch",
                             )
             return out
 

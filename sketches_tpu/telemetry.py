@@ -47,6 +47,17 @@ library's own telemetry).  A declared :data:`SLOS` inventory (target +
 window + burn-rate threshold per metric) is evaluated by
 :func:`check_slo` against any snapshot, merged or not.
 
+Tracing semantics (r13): spans and histogram observations taken while
+the flight recorder (``sketches_tpu.tracing``) is armed link to the
+current :class:`~sketches_tpu.tracing.TraceContext` -- chrome events
+carry the ids (rendered as causal flow arrows), latency-histogram bins
+retain bounded ``(trace_id, wall_time, value)`` **exemplar
+reservoirs** (deterministic splitmix64 bottom-k; survive
+:func:`merge_snapshots` by concat + re-reservoir, drops counted),
+:func:`prometheus_text` annotates quantile lines OpenMetrics-style,
+and :func:`exemplars_for` answers "which traces sit behind this
+histogram's p99 bin".
+
 CLI: ``python -m sketches_tpu.telemetry --check-bench OLD NEW`` is the
 bench regression gate -- it compares two ``bench.py`` summary documents
 (e.g. the checked-in ``BENCH_local_r*.json``) metric by metric against
@@ -102,6 +113,11 @@ __all__ = [
     "merge_snapshots",
     "prometheus_text",
     "chrome_trace",
+    "exemplars_for",
+    "CHROME_PID_SPANS",
+    "CHROME_PID_DEVICE",
+    "EXEMPLARS_PER_BIN",
+    "EXEMPLAR_BINS",
     "check_bench",
     "SLO",
     "SLOS",
@@ -118,6 +134,25 @@ TELEMETRY_ENV = registry.TELEMETRY.name
 #: snapshot reports are within 1% of the recorded durations' exact
 #: quantiles (the DDSketch contract, applied to ourselves).
 HISTOGRAM_REL_ACC = 0.01
+
+#: Declared, collision-free Chrome-trace process-track scheme: host
+#: telemetry spans render on pid 1 (one tid per thread), the profiling
+#: layer's device-clocked dispatches on pid 2 (one tid per engine
+#: tier).  Both pids carry ``process_name``/``thread_name`` metadata
+#: events so Perfetto labels tracks instead of showing bare ids; any
+#: future track must claim a fresh pid here.
+CHROME_PID_SPANS = 1
+CHROME_PID_DEVICE = 2
+
+#: Per-bin exemplar-reservoir bound: each latency-histogram bin retains
+#: at most this many ``(trace_id, wall_time, value)`` exemplars
+#: (deterministic splitmix64 bottom-k selection keyed on the trace id --
+#: no RNG, replays exactly; the ``accuracy.py`` reservoir discipline).
+EXEMPLARS_PER_BIN = 4
+
+#: Bound on distinct bins carrying exemplars per histogram series
+#: (overflow dropped + counted -- the ring discipline).
+EXEMPLAR_BINS = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -196,6 +231,18 @@ _DECLARED = (
            "Checkpoint load+validate wall time."),
     Metric("spans.dropped", "counter", "sketches_tpu.telemetry",
            "Trace events dropped because the 65k span ring was full."),
+    Metric("tracing.traces", "counter", "sketches_tpu.tracing",
+           "Root trace contexts minted (one per served/instrumented"
+           " request while the recorder is armed)."),
+    Metric("tracing.events", "counter", "sketches_tpu.tracing",
+           "Structured events recorded into the flight-recorder ring"
+           " (spans, decisions, faults, downgrades)."),
+    Metric("tracing.dropped", "counter", "sketches_tpu.tracing",
+           "Flight-recorder events overwritten because the bounded ring"
+           " wrapped (the oldest event is replaced, never the newest)."),
+    Metric("tracing.dumps", "counter", "sketches_tpu.tracing",
+           "Forensic bundles dumped (auto-triggered by SLO burns, serve"
+           " errors, cache poison, chaos classifications, or explicit)."),
     Metric("profiling.device_s", "histogram", "sketches_tpu.profiling",
            "Device-clocked (block_until_ready) dispatch time, attributed"
            " per phase and engine tier (labels: phase, tier)."),
@@ -281,6 +328,21 @@ def _raise_value_error(msg: str) -> None:
     raise SketchValueError(msg)
 
 
+_tracing_cached = None
+
+
+def _tracing():
+    """The tracing module, imported lazily (tracing imports telemetry at
+    load, so the reverse edge must be deferred to call time).  Armed
+    code paths only -- the disarmed fast path never reaches this."""
+    global _tracing_cached
+    if _tracing_cached is None:
+        from sketches_tpu import tracing as _t
+
+        _tracing_cached = _t
+    return _tracing_cached
+
+
 def declare(
     name: str, kind: str, doc: str, owner: str = "user", merge: str = "max"
 ) -> Metric:
@@ -347,10 +409,14 @@ def enable(on: bool = True) -> None:
     """Arm (or, with ``on=False``, disarm) the telemetry layer.
 
     Never raises; the pre-existing metric state is kept (use
-    :func:`reset` to clear it).
+    :func:`reset` to clear it).  The flight recorder
+    (``sketches_tpu.tracing``) follows this arming state -- it is
+    always-armed-when-telemetry-is-armed unless its own kill switch
+    disables it.
     """
     global _ACTIVE
     _ACTIVE = bool(on)
+    _tracing()._sync(_ACTIVE)
 
 
 def disable() -> None:
@@ -442,16 +508,36 @@ def _sketch_from_state(state: dict, rel_acc: float):
     return sk
 
 
+def _exemplar_priority(trace_hex: str) -> int:
+    """Deterministic reservoir priority of an exemplar: splitmix64 of
+    its trace id.  A pure function of the id, so merge operands agree
+    on selection without storing priorities (re-reservoir = bottom-k of
+    the union).  An unparseable id sorts last (kept only if room)."""
+    from sketches_tpu import tracing as _t
+
+    try:
+        return _t.splitmix64(int(trace_hex, 16))
+    except (TypeError, ValueError):
+        return (1 << 64) - 1
+
+
 class _Hist:
-    """One histogram: a host-tier DDSketch plus exact min/max.
+    """One histogram: a host-tier DDSketch plus exact min/max, plus a
+    small per-bin exemplar reservoir linking histogram mass to traces.
 
     The sketch import is lazy (first armed observation), so importing
     telemetry never pays for the sketch stack; count/sum come from the
-    sketch's own (exact, f64) bookkeeping.  Failure modes follow the
-    sketch's: quantiles of an empty histogram read as None/NaN.
+    sketch's own (exact, f64) bookkeeping.  Exemplars are recorded only
+    for trace-bearing positive observations (the latency case): each
+    mapping bin keeps at most :data:`EXEMPLARS_PER_BIN` entries,
+    selected by the deterministic splitmix64 bottom-k priority of their
+    trace ids; at most :data:`EXEMPLAR_BINS` bins carry exemplars
+    (overflow dropped + counted).  Failure modes follow the sketch's:
+    quantiles of an empty histogram read as None/NaN.
     """
 
-    __slots__ = ("sketch", "min", "max")
+    __slots__ = ("sketch", "min", "max", "exemplars", "exemplars_seen",
+                 "exemplars_dropped")
 
     def __init__(self):
         from sketches_tpu.ddsketch import DDSketch
@@ -459,13 +545,35 @@ class _Hist:
         self.sketch = DDSketch(HISTOGRAM_REL_ACC)
         self.min = math.inf
         self.max = -math.inf
+        self.exemplars: Dict[int, List[Tuple[int, str, float, float]]] = {}
+        self.exemplars_seen = 0
+        self.exemplars_dropped = 0
 
-    def add(self, value: float) -> None:
+    def add(self, value: float, exemplar: Optional[Tuple[str, float]] = None
+            ) -> None:
         self.sketch.add(value)
         if value < self.min:
             self.min = value
         if value > self.max:
             self.max = value
+        if exemplar is not None and value > 0:
+            self._add_exemplar(value, exemplar)
+
+    def _add_exemplar(self, value: float, ex: Tuple[str, float]) -> None:
+        trace_hex, wall = ex
+        self.exemplars_seen += 1
+        key = self.sketch.mapping.key(value)
+        lst = self.exemplars.get(key)
+        if lst is None:
+            if len(self.exemplars) >= EXEMPLAR_BINS:
+                self.exemplars_dropped += 1
+                return
+            lst = self.exemplars[key] = []
+        lst.append((_exemplar_priority(trace_hex), trace_hex, wall, value))
+        if len(lst) > EXEMPLARS_PER_BIN:
+            lst.sort()
+            lst.pop()
+            self.exemplars_dropped += 1
 
     def summary(self) -> dict:
         sk = self.sketch
@@ -484,6 +592,16 @@ class _Hist:
         # bins by key addition -- exactly DDSketch.merge -- and the
         # fleet-wide quantiles keep the alpha contract.
         out["state"] = _sketch_state(sk)
+        if self.exemplars_seen:
+            out["exemplars"] = {
+                str(k): [
+                    {"trace_id": t, "wall_time": w, "value": v}
+                    for (_p, t, w, v) in sorted(lst)
+                ]
+                for k, lst in sorted(self.exemplars.items())
+            }
+            out["exemplars_seen"] = self.exemplars_seen
+            out["exemplars_dropped"] = self.exemplars_dropped
         return out
 
 
@@ -514,22 +632,37 @@ def gauge_set(name: str, value: float, **labels) -> None:
         _gauges[_key(name, labels)] = float(value)
 
 
-def observe(name: str, seconds: float, **labels) -> None:
+def _trace_of(trace):
+    """The effective trace context of an armed observation: the
+    explicit ``trace=`` argument, else the tracing layer's current
+    context (None when tracing is disarmed or nothing is bound)."""
+    t = _tracing()
+    if not t._ACTIVE:
+        return None
+    return trace if trace is not None else t.current()
+
+
+def observe(name: str, seconds: float, trace=None, **labels) -> None:
     """Feed one duration into histogram ``name`` (no-op while disarmed).
 
     Raises ``SketchValueError`` for an undeclared name or a
     non-histogram metric; the value lands in a DDSketch, so snapshot
-    quantiles are within ``HISTOGRAM_REL_ACC`` of exact.
+    quantiles are within ``HISTOGRAM_REL_ACC`` of exact.  ``trace``
+    (a ``tracing.TraceContext``; defaults to the current bound context
+    when the flight recorder is armed) attaches a ``(trace_id,
+    wall_time, value)`` exemplar to the value's histogram bin.
     """
     if not _ACTIVE:
         return
     _metric(name, "histogram")
+    ctx = _trace_of(trace)
+    ex = (ctx.trace_hex, wall_time()) if ctx is not None else None
     k = _key(name, labels)
     with _lock:
         h = _hists.get(k)
         if h is None:
             h = _hists[k] = _Hist()
-        h.add(float(seconds))
+        h.add(float(seconds), ex)
 
 
 def _tid() -> int:
@@ -552,27 +685,41 @@ def _append_event(ev: dict) -> None:
         _counters[k] = _counters.get(k, 0.0) + 1.0
 
 
-def finish_span(name: str, t0: float, **labels) -> float:
+def finish_span(name: str, t0: float, trace=None, **labels) -> float:
     """Close a span opened at ``t0 = telemetry.clock()`` -> duration.
 
     Feeds histogram ``name`` and appends one Chrome-trace ``X`` event
     (per-thread track, bounded ring).  The explicit-``t0`` form is the
     hot-seam idiom: the seam pays ONE bool test while disarmed
     (``t0 = telemetry.clock() if telemetry._ACTIVE else None``) instead
-    of a context-manager allocation.  Raises ``SketchValueError`` for an
-    undeclared name; while disarmed it records nothing and returns 0.0.
+    of a context-manager allocation.  ``trace`` (optional, defaults to
+    the tracing layer's current context when armed; old callers are
+    unchanged) links the span into its request's trace: the chrome
+    event carries the ids (rendered as causal flow arrows by
+    :func:`chrome_trace`), the histogram bin gains an exemplar, and the
+    flight recorder mirrors the span.  Raises ``SketchValueError`` for
+    an undeclared name; while disarmed it records nothing and returns
+    0.0.
     """
     if not _ACTIVE:
         return 0.0
     _metric(name, "histogram")
     now = clock()
     dur = max(now - t0, 0.0)
+    ctx = _trace_of(trace)
+    ex = (ctx.trace_hex, wall_time()) if ctx is not None else None
+    args = {k2: str(v) for k2, v in labels.items()}
+    if ctx is not None:
+        args.update(
+            trace_id=ctx.trace_hex, span_id=ctx.span_hex,
+            parent_id=ctx.parent_hex or "",
+        )
     k = _key(name, labels)
     with _lock:
         h = _hists.get(k)
         if h is None:
             h = _hists[k] = _Hist()
-        h.add(dur)
+        h.add(dur, ex)
         _append_event(
             {
                 "name": name,
@@ -580,11 +727,17 @@ def finish_span(name: str, t0: float, **labels) -> float:
                 "ph": "X",
                 "ts": (t0 - _epoch_pc) * 1e6,
                 "dur": dur * 1e6,
-                "pid": 1,
+                "pid": CHROME_PID_SPANS,
                 "tid": _tid(),
-                "args": {k2: str(v) for k2, v in labels.items()},
+                "args": args,
             }
         )
+    t = _tracing()
+    if t._ACTIVE:
+        # Mirror the span into the flight recorder (outside _lock:
+        # record_event takes the recorder's own lock and the declared
+        # tracing.events counter re-enters this module's API).
+        t.record_event("span", ctx=ctx, name=name, dur_s=dur, **labels)
     return dur
 
 
@@ -651,7 +804,7 @@ def event(name: str, **labels) -> None:
                 "ph": "i",
                 "s": "t",
                 "ts": (clock() - _epoch_pc) * 1e6,
-                "pid": 1,
+                "pid": CHROME_PID_SPANS,
                 "tid": _tid(),
                 "args": {k2: str(v) for k2, v in labels.items()},
             }
@@ -708,6 +861,9 @@ def snapshot() -> dict:
 
     if _accuracy._ACTIVE:
         out["accuracy"] = _accuracy.summary()
+    t = _tracing()
+    if t._ACTIVE:
+        out["tracing"] = t.stats()
     return out
 
 
@@ -725,12 +881,49 @@ def _prom_labels(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _quantile_key(state: dict, q: float) -> Optional[int]:
+    """The mapping key of the bin containing quantile ``q`` of an
+    embedded histogram state (negative/zero mass ranks below the
+    positive bins -- the positive-valued latency case this layer
+    records).  None for an empty state."""
+    pos = {int(k): float(v) for k, v in (state.get("pos") or {}).items()}
+    neg_total = sum(float(v) for v in (state.get("neg") or {}).values())
+    zero = float(state.get("zero_count", 0.0))
+    total = zero + neg_total + sum(pos.values())
+    if total <= 0 or not pos:
+        return None
+    rank = q * total
+    cum = zero + neg_total
+    for k in sorted(pos):
+        cum += pos[k]
+        if cum >= rank:
+            return k
+    return max(pos)
+
+
+def _exemplar_near(summary: dict, key: Optional[int]) -> Optional[dict]:
+    """The exemplar entry nearest bin ``key`` (exact bin preferred,
+    else smallest key distance) -> entry dict + its bin, or None when
+    the summary carries no exemplars."""
+    ex = summary.get("exemplars")
+    if not isinstance(ex, dict) or not ex or key is None:
+        return None
+    best = min((int(k) for k in ex), key=lambda kk: abs(kk - key))
+    entries = ex[str(best)]
+    if not entries:
+        return None
+    return {"bin": best, **entries[0]}
+
+
 def prometheus_text() -> str:
     """Prometheus text exposition of the current metrics.
 
     Counters export with a ``_total`` suffix, histograms as summaries
     (``quantile`` label series + ``_sum``/``_count``), all under the
-    ``sketches_tpu_`` prefix.  An empty exposition is the disarmed/idle
+    ``sketches_tpu_`` prefix.  Quantile lines whose bin carries a trace
+    exemplar append an OpenMetrics-style exemplar annotation
+    (``# {trace_id="..."} value timestamp``) linking the bucket to the
+    trace that landed there.  An empty exposition is the disarmed/idle
     steady state; parse failures are the consumer's to report.
     """
     with _lock:
@@ -760,16 +953,120 @@ def prometheus_text() -> str:
     for (name, labels), s in sorted(hists.items()):
         prom = _prom_name(name)
         header(name, prom, "summary")
+        state = s.get("state") or {}
         for q, label in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"),
                          (0.999, "p999")):
             val = s[label]
             if val is None:
                 continue
             qlabel = 'quantile="%g"' % q
-            lines.append(f"{prom}{_prom_labels(labels, qlabel)} {val:g}")
+            line = f"{prom}{_prom_labels(labels, qlabel)} {val:g}"
+            ex = _exemplar_near(s, _quantile_key(state, q))
+            if ex is not None:
+                line += (
+                    f' # {{trace_id="{ex["trace_id"]}"}}'
+                    f" {ex['value']:g} {ex['wall_time']:.3f}"
+                )
+            lines.append(line)
         lines.append(f"{prom}_sum{_prom_labels(labels)} {s['sum']:g}")
         lines.append(f"{prom}_count{_prom_labels(labels)} {s['count']:g}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def exemplars_for(snap: dict, metric: str, q: float = 0.99) -> dict:
+    """Which traces sit behind quantile ``q`` of histogram ``metric`` in
+    a snapshot -> ``{"metric", "q", "bin_key", "bin_value",
+    "exemplar_bin", "exemplars": [{trace_id, wall_time, value}, ...]}``.
+
+    Folds the metric's label series first (so merged and single-process
+    snapshots answer alike), locates the bin containing ``q`` from the
+    embedded sketch state, and returns that bin's exemplar reservoir
+    (nearest exemplar-bearing bin when the exact bin kept none --
+    reservoirs only hold traced observations).  An empty ``exemplars``
+    list means no traced observation reached the neighborhood, not an
+    error.  Raises ``SketchValueError`` when the snapshot carries no
+    such histogram or no embedded bin state.
+    """
+    rel_acc = float(
+        snap.get("histogram_relative_accuracy", HISTOGRAM_REL_ACC)
+    )
+    series = [
+        sm for k, sm in (snap.get("histograms") or {}).items()
+        if _series_name(k) == metric
+    ]
+    if not series:
+        _raise_value_error(
+            f"snapshot carries no histogram named {metric!r}"
+        )
+    merged = (
+        series[0] if len(series) == 1
+        else _merge_hist_summaries(series, rel_acc)
+    )
+    state = merged.get("state")
+    if not isinstance(state, dict):
+        _raise_value_error(
+            f"histogram {metric!r} carries no embedded bin state (pre-r11"
+            " snapshot); exemplars cannot be located"
+        )
+    key = _quantile_key(state, q)
+    from sketches_tpu.mapping import LogarithmicMapping
+
+    mapping = LogarithmicMapping(rel_acc)
+    ex = merged.get("exemplars") or {}
+    exemplar_bin = None
+    entries: List[dict] = []
+    if ex and key is not None:
+        exemplar_bin = min(
+            (int(k) for k in ex), key=lambda kk: abs(kk - key)
+        )
+        entries = list(ex[str(exemplar_bin)])
+    return {
+        "metric": metric,
+        "q": q,
+        "bin_key": key,
+        "bin_value": mapping.value(key) if key is not None else None,
+        "exemplar_bin": exemplar_bin,
+        "exemplars": entries,
+    }
+
+
+def _flow_events(events: List[dict]) -> List[dict]:
+    """Causal flow arrows linking trace-linked spans: for every span
+    whose recorded ``parent_id`` is another recorded span, emit a
+    Chrome flow start (``s``) at the parent and a binding-at-enclosing
+    end (``f``/``bp=e``) at the child, id'd by the child span.  Spans
+    without trace ids (or with parents outside the ring) emit nothing
+    -- absent linkage degrades to plain spans, never an error."""
+    by_span: Dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        span = (e.get("args") or {}).get("span_id")
+        if span:
+            by_span[span] = e
+    flows: List[dict] = []
+    for e in by_span.values():
+        args = e.get("args") or {}
+        parent = by_span.get(args.get("parent_id") or "")
+        if parent is None:
+            continue
+        common = {
+            "name": "trace", "cat": "sketches_tpu.flow",
+            "id": args["span_id"],
+        }
+        flows.append(
+            {
+                **common, "ph": "s", "pid": parent["pid"],
+                "tid": parent["tid"], "ts": parent["ts"],
+            }
+        )
+        flows.append(
+            {
+                **common, "ph": "f", "bp": "e", "pid": e["pid"],
+                "tid": e["tid"], "ts": max(e["ts"], parent["ts"]),
+            }
+        )
+    return flows
 
 
 def chrome_trace() -> dict:
@@ -777,9 +1074,12 @@ def chrome_trace() -> dict:
 
     Same ``traceEvents`` conventions ``bench.py`` parses from the TPU
     runtime (``process_name``/``thread_name`` metadata + ``X`` duration
-    events), so one viewer serves both.  When the profiling layer is
-    armed its device-clocked dispatch events ride along as a second
-    process track (pid 2, one thread per engine tier).  An empty event
+    events), so one viewer serves both.  The pid scheme is declared and
+    collision-free (:data:`CHROME_PID_SPANS` for host span threads,
+    :data:`CHROME_PID_DEVICE` for the profiling layer's device track),
+    with ``thread_name`` metadata on every track; spans carrying trace
+    ids are additionally linked by causal flow events (``s``/``f``), so
+    Perfetto draws the request's path across threads.  An empty event
     list is the disarmed/idle steady state.
     """
     with _lock:
@@ -789,7 +1089,7 @@ def chrome_trace() -> dict:
         {
             "name": "process_name",
             "ph": "M",
-            "pid": 1,
+            "pid": CHROME_PID_SPANS,
             "args": {"name": "sketches_tpu telemetry"},
         }
     ]
@@ -798,12 +1098,12 @@ def chrome_trace() -> dict:
             {
                 "name": "thread_name",
                 "ph": "M",
-                "pid": 1,
+                "pid": CHROME_PID_SPANS,
                 "tid": t,
                 "args": {"name": f"thread-{ident}"},
             }
         )
-    all_events = meta + events
+    all_events = meta + events + _flow_events(events)
     from sketches_tpu import profiling as _profiling
 
     if _profiling._ACTIVE:
@@ -826,12 +1126,63 @@ def _gauge_policy(rendered: str) -> str:
     return m.merge if m is not None and m.kind == "gauge" else "max"
 
 
+def _merge_exemplars(summaries: List[dict]) -> Optional[Tuple[dict, int, int]]:
+    """Fold the operands' exemplar reservoirs -> ``(bins, seen,
+    dropped)`` or None when no operand carries exemplars.
+
+    Concat + re-reservoir: per bin, the union of entries (deduplicated
+    on the full triple) is re-selected bottom-k by the splitmix64
+    priority of the trace ids -- the same deterministic discipline the
+    live reservoir applies, so the fold is associative and commutative
+    (bounded top-k of a multiset under a fixed total order).  The bin
+    set is ring-bounded at :data:`EXEMPLAR_BINS`, keeping the LARGEST
+    keys (the tail bins exemplars exist for); everything trimmed is
+    counted: ``dropped == seen - kept`` by construction.
+    """
+    by_bin: Dict[int, Dict[Tuple[str, float, float], dict]] = {}
+    seen = 0
+    any_ex = False
+    for sm in summaries:
+        seen += int(sm.get("exemplars_seen", 0) or 0)
+        ex = sm.get("exemplars")
+        if not isinstance(ex, dict):
+            continue
+        any_ex = True
+        for bk, lst in ex.items():
+            bucket = by_bin.setdefault(int(bk), {})
+            for e in lst:
+                entry = {
+                    "trace_id": str(e["trace_id"]),
+                    "wall_time": float(e["wall_time"]),
+                    "value": float(e["value"]),
+                }
+                bucket[
+                    (entry["trace_id"], entry["wall_time"], entry["value"])
+                ] = entry
+    if not any_ex and seen == 0:
+        return None
+    kept = 0
+    out: Dict[str, List[dict]] = {}
+    for bk in sorted(sorted(by_bin, reverse=True)[:EXEMPLAR_BINS]):
+        cand = sorted(
+            by_bin[bk].values(),
+            key=lambda e: (
+                _exemplar_priority(e["trace_id"]), e["wall_time"], e["value"]
+            ),
+        )[:EXEMPLARS_PER_BIN]
+        kept += len(cand)
+        out[str(bk)] = cand
+    return out, seen, max(seen - kept, 0)
+
+
 def _merge_hist_summaries(summaries: List[dict], rel_acc: float) -> dict:
     """Fold N histogram summaries into one by DDSketch bin addition.
 
     Same-key bin mass adds (exactly ``DDSketch.merge`` on equal-gamma
     sketches), so the merged quantiles carry the single-process alpha
-    contract; count/sum/min/max fold exactly.  Raises
+    contract; count/sum/min/max fold exactly; exemplar reservoirs
+    concat + re-reservoir deterministically (:func:`_merge_exemplars`
+    -- the fold stays associative/commutative, drops counted).  Raises
     ``SketchValueError`` when a summary has no embedded bin state (a
     pre-r11 snapshot cannot be merged, only read).
     """
@@ -870,6 +1221,11 @@ def _merge_hist_summaries(summaries: List[dict], rel_acc: float) -> dict:
                      (0.999, "p999")):
         out[label] = sk.get_quantile_value(q)
     out["state"] = state
+    merged_ex = _merge_exemplars(summaries)
+    if merged_ex is not None:
+        out["exemplars"], out["exemplars_seen"], out["exemplars_dropped"] = (
+            merged_ex
+        )
     return out
 
 
@@ -1032,6 +1388,18 @@ def merge_snapshots(*snaps: dict) -> dict:
     profs = [s["profiling"] for s in snaps if isinstance(s.get("profiling"), dict)]
     if profs:
         out["profiling"] = _merge_profiling(profs)
+    trcs = [s["tracing"] for s in snaps if isinstance(s.get("tracing"), dict)]
+    if trcs:
+        out["tracing"] = {
+            "events": sum(int(t.get("events", 0)) for t in trcs),
+            "recorded": sum(int(t.get("recorded", 0)) for t in trcs),
+            "dropped": sum(int(t.get("dropped", 0)) for t in trcs),
+            "capacity": max(int(t.get("capacity", 0)) for t in trcs),
+            "bundles": sum(int(t.get("bundles", 0)) for t in trcs),
+            "bundles_dropped": sum(
+                int(t.get("bundles_dropped", 0)) for t in trcs
+            ),
+        }
     accs = [s["accuracy"] for s in snaps if isinstance(s.get("accuracy"), dict)]
     if accs:
         out["accuracy"] = {
@@ -1376,6 +1744,44 @@ def _load_json(path: str) -> dict:
         return json.load(f)
 
 
+def _slo_forensics(
+    snap_doc: dict, snap_path: str, burning: int, evaluated: int
+) -> None:
+    """The ``--check-slo`` burn auto-trigger: dump a forensic bundle
+    next to the offending snapshot (``<snapshot>.forensics.json``),
+    with the p99 exemplar trace of a burning-candidate latency metric
+    as the triggering trace.  Best-effort: a failed dump prints and
+    moves on -- the gate's exit code is the contract, forensics are a
+    bonus."""
+    try:
+        trigger = None
+        for slo in SLOS:
+            if slo.kind != "latency":
+                continue
+            try:
+                found = exemplars_for(snap_doc, slo.metric, 0.99)
+            except Exception:  # noqa: BLE001 - metric absent from snapshot
+                continue
+            if found["exemplars"]:
+                trigger = found["exemplars"][0]["trace_id"]
+                break
+        t = _tracing()
+        out_path = snap_path + ".forensics.json"
+        t.dump_forensics(
+            "slo-burn",
+            trace=trigger,
+            detail={
+                "snapshot": snap_path, "burning": burning,
+                "evaluated": evaluated,
+            },
+            snapshot=snap_doc,
+            path=out_path,
+        )
+        print(f"check-slo: forensic bundle -> {out_path}")
+    except Exception as e:  # noqa: BLE001 - forensics must not mask the gate
+        print(f"check-slo: forensic dump failed: {e!r}")
+
+
 def _dump_json(doc: dict, path: Optional[str]) -> None:
     text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
     if path:
@@ -1483,7 +1889,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     if args.check_slo:
-        lines, burning, evaluated = check_slo(_load_json(args.check_slo))
+        snap_doc = _load_json(args.check_slo)
+        lines, burning, evaluated = check_slo(snap_doc)
         for line in lines:
             print(line)
         if evaluated == 0:
@@ -1494,6 +1901,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 2
         if burning:
             print(f"check-slo: {burning}/{evaluated} SLO(s) BURNING")
+            _slo_forensics(snap_doc, args.check_slo, burning, evaluated)
             return 1
         print(f"check-slo: {evaluated} SLO(s) within budget")
         return 0

@@ -31,7 +31,21 @@ def pytest_sessionfinish(session, exitstatus):
     job) leave the whole suite's self-sketched snapshot as an artifact:
     TELEMETRY_SNAPSHOT_PATH gets the Prometheus exposition, plus a
     ``.json`` sibling with the full snapshot (resilience ledger bridged
-    in).  Disarmed runs write nothing."""
+    in).  FLIGHT_RECORDER_BUNDLE_PATH additionally gets an end-of-suite
+    forensic bundle when the flight recorder saw anything (CI uploads
+    it on failure).  Disarmed runs write nothing."""
+    bundle_path = os.environ.get("FLIGHT_RECORDER_BUNDLE_PATH")
+    if bundle_path:
+        try:
+            from sketches_tpu import tracing
+
+            if tracing.enabled() or tracing.bundles():
+                tracing.dump_forensics(
+                    f"pytest-sessionfinish:exit={exitstatus}",
+                    path=bundle_path,
+                )
+        except Exception:
+            pass  # a forensic artifact must never mask the suite verdict
     path = os.environ.get("TELEMETRY_SNAPSHOT_PATH")
     if not path:
         return

@@ -1,0 +1,728 @@
+"""Request-tracing + flight-recorder acceptance suite (ISSUE r13).
+
+Proves the contract the forensic layer is sold on:
+
+(a) DISARMED is genuinely free: with telemetry and the recorder both
+    off, every instrumented seam (record_event, the serve admission
+    path, engine dispatch) adds no clock reads -- proven with
+    booby-trapped clocks, the ``faults.py`` discipline;
+(b) trace ids are deterministic: the seeded splitmix64 counter mints
+    the exact same id sequence every run -- the chaos-replay contract;
+(c) the recorder ring stays bounded with drops counted under an
+    8-thread soak on the virtual clock (zero sleeps anywhere);
+(d) histogram exemplars link bins to traces: bounded per-bin
+    reservoirs, deterministic selection, surviving ``merge_snapshots``
+    associatively and commutatively, queryable via ``exemplars_for``
+    and annotated OpenMetrics-style in ``prometheus_text`` (parsed
+    back by the conformance test);
+(e) the chrome trace's pid/tid scheme is declared and collision-free,
+    every track carries ``thread_name``/``process_name`` metadata, and
+    trace-linked spans emit causal flow events;
+(f) forensic bundles auto-dump on cache poison, non-structured serve
+    errors, chaos fault classifications, and SLO burns -- and
+    ``--explain`` reconstructs the triggering request's causal chain
+    (admission -> cache/hedge/breaker decisions -> resolved engine
+    tier) from the bundle alone.
+"""
+
+import json
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from sketches_tpu import chaos, faults, resilience, serve, telemetry, tracing
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.resilience import SketchValueError
+
+SPEC = SketchSpec(relative_accuracy=0.02, n_bins=128)
+
+
+class VirtualClock:
+    """Deterministic clock: manual ``advance`` plus an optional per-read
+    ``auto_step`` (models elapsed time without sleeping)."""
+
+    def __init__(self, auto_step: float = 0.0):
+        self.t = 0.0
+        self.auto_step = auto_step
+
+    def __call__(self) -> float:
+        self.t += self.auto_step
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers():
+    """Every test starts with telemetry+tracing disarmed, empty rings,
+    default capacity/clock, and the default id seed; the process arming
+    state is restored after (the telemetry CI job runs armed)."""
+    tele_was = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    tracing.reset()
+    tracing.configure(capacity=tracing.RECORDER_CAPACITY,
+                      clock=telemetry.clock)
+    faults.disarm()
+    resilience.reset()
+    yield
+    faults.disarm()
+    resilience.reset()
+    tracing.reset()
+    tracing.configure(capacity=tracing.RECORDER_CAPACITY,
+                      clock=telemetry.clock)
+    telemetry.reset()
+    telemetry.enable(tele_was)
+
+
+def _server(clock=None, **cfg):
+    srv = serve.SketchServer(serve.ServeConfig(**cfg), clock=clock)
+    srv.add_tenant("a", 8, spec=SPEC)
+    rng = np.random.RandomState(7)
+    srv.ingest("a", rng.lognormal(0.0, 0.5, (8, 64)).astype(np.float32))
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# (a) Disarmed path: one bool test, no clock reads
+# ---------------------------------------------------------------------------
+
+
+class TestDisarmed:
+    def test_disarmed_by_default_and_follows_telemetry(self):
+        assert not tracing.enabled()
+        telemetry.enable()
+        assert tracing.enabled()
+        telemetry.disable()
+        assert not tracing.enabled()
+
+    def test_kill_switch_refuses_arming(self, monkeypatch):
+        monkeypatch.setattr(tracing, "_KILL", False)
+        telemetry.enable()
+        assert not tracing.enabled()
+        tracing.enable(True)
+        assert not tracing.enabled()
+
+    def test_disarmed_seams_read_no_clock_and_record_nothing(
+        self, monkeypatch
+    ):
+        """Booby-trap BOTH clocks the recorder could reach, then drive
+        the instrumented seams disarmed: one clock read fails the test
+        (the ``faults.py`` discipline, applied to this layer)."""
+
+        def boom():  # pragma: no cover - firing IS the failure
+            raise AssertionError("clock read on the disarmed tracing path")
+
+        monkeypatch.setattr(telemetry, "clock", boom)
+        tracing.configure(clock=boom)
+        tracing.record_event("anything", free="text")
+        vc = VirtualClock()
+        srv = _server(clock=vc)
+        srv.ingest("a", np.ones((8, 4), np.float32))
+        srv.query("a", [0.5, 0.99])  # admission + dispatch seams
+        sk = BatchedDDSketch(4, spec=SPEC)
+        sk.add(np.ones((4, 8), np.float32))
+        sk.get_quantile_values([0.5])  # engine seams
+        assert tracing.events() == []
+        assert tracing.stats()["recorded"] == 0
+
+    def test_disarmed_recording_is_noop_but_minting_still_works(self):
+        tracing.record_event("dropped.on.the.floor")
+        assert tracing.events() == []
+        # Explicit minting is always allowed (callers may pre-plumb).
+        ctx = tracing.new_trace()
+        assert ctx.trace_id and ctx.span_id and ctx.parent_id == 0
+
+
+# ---------------------------------------------------------------------------
+# (b) Deterministic ids
+# ---------------------------------------------------------------------------
+
+
+class TestIds:
+    def test_seeded_replay_is_exact(self):
+        tracing.seed_ids(7)
+        first = [tracing.new_trace() for _ in range(8)]
+        tracing.seed_ids(7)
+        again = [tracing.new_trace() for _ in range(8)]
+        assert first == again
+
+    def test_distinct_seeds_distinct_streams(self):
+        tracing.seed_ids(1)
+        a = tracing.new_trace()
+        tracing.seed_ids(2)
+        b = tracing.new_trace()
+        assert a.trace_id != b.trace_id
+
+    def test_ids_never_zero_and_hex_roundtrips(self):
+        tracing.seed_ids(0)
+        for _ in range(64):
+            ctx = tracing.new_trace()
+            assert ctx.trace_id != 0 and ctx.span_id != 0
+            assert int(ctx.trace_hex, 16) == ctx.trace_id
+            assert ctx.parent_hex is None
+
+    def test_child_span_links_and_none_falls_back_to_root(self):
+        root = tracing.new_trace()
+        child = tracing.child_span(root)
+        assert child.trace_id == root.trace_id
+        assert child.parent_id == root.span_id
+        assert child.span_id not in (root.span_id, 0)
+        orphan = tracing.child_span(None)
+        assert orphan.parent_id == 0
+
+    def test_contextvar_binding_is_exception_safe(self):
+        ctx = tracing.new_trace()
+        with pytest.raises(RuntimeError):
+            with tracing.use(ctx):
+                assert tracing.current() is ctx
+                raise RuntimeError("boom")
+        assert tracing.current() is None
+
+    def test_splitmix64_reference_vector(self):
+        # Reference value from the published splitmix64 (seed 0 first
+        # output) -- pins the exemplar-priority hash across refactors.
+        assert tracing.splitmix64(0) == 0xE220A8397B1DCDAF
+
+
+# ---------------------------------------------------------------------------
+# (c) Recorder ring: bounded, drops counted, thread-safe, zero sleeps
+# ---------------------------------------------------------------------------
+
+
+class TestRecorderRing:
+    def test_ring_bounds_and_counts_drops(self):
+        tracing.enable(True)
+        tracing.configure(capacity=8, clock=VirtualClock(1e-3))
+        for i in range(20):
+            tracing.record_event("tick", i=i)
+        evs = tracing.events()
+        assert len(evs) == 8
+        # Oldest overwritten: the survivors are the LAST 8, in order.
+        assert [e["i"] for e in evs] == list(range(12, 20))
+        st = tracing.stats()
+        assert st["recorded"] == 20 and st["dropped"] == 12
+
+    def test_shrinking_capacity_trims_oldest_counted(self):
+        tracing.enable(True)
+        tracing.configure(capacity=16, clock=VirtualClock(1e-3))
+        for i in range(10):
+            tracing.record_event("tick", i=i)
+        tracing.configure(capacity=4)
+        evs = tracing.events()
+        assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        assert tracing.stats()["dropped"] == 6
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(SketchValueError):
+            tracing.configure(capacity=0)
+
+    def test_eight_thread_soak_on_virtual_clock(self):
+        """8 writer threads, one bounded ring, zero sleeps: no event is
+        malformed, the ring never exceeds capacity, and the accounting
+        identity recorded == kept + dropped holds exactly."""
+        tracing.enable(True)
+        tracing.configure(capacity=64, clock=VirtualClock(1e-6))
+        n_threads, per_thread = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def writer(t):
+            barrier.wait()
+            for i in range(per_thread):
+                tracing.record_event("soak", thread=t, i=i)
+
+        threads = [
+            threading.Thread(target=writer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        st = tracing.stats()
+        evs = tracing.events()
+        assert len(evs) == 64
+        assert st["recorded"] == n_threads * per_thread
+        assert st["dropped"] == st["recorded"] - 64
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+
+
+# ---------------------------------------------------------------------------
+# (d) Exemplars: bounded reservoirs, merge algebra, exposition
+# ---------------------------------------------------------------------------
+
+
+def _traced_snapshot(values, seed, metric="query_s"):
+    """One process's snapshot with a traced observation per value."""
+    telemetry.enable()
+    telemetry.reset()
+    tracing.seed_ids(seed)
+    for v in values:
+        telemetry.observe(metric, float(v), trace=tracing.new_trace())
+    snap = telemetry.snapshot()
+    telemetry.disable()
+    telemetry.reset()
+    return snap
+
+
+class TestExemplars:
+    def test_observation_without_recorder_keeps_no_exemplar(self):
+        telemetry.enable()
+        tracing.enable(False)  # telemetry on, recorder explicitly off
+        telemetry.observe("query_s", 0.01)
+        (h,) = telemetry.snapshot()["histograms"].values()
+        assert "exemplars" not in h
+
+    def test_traced_observations_land_in_bins_bounded(self):
+        telemetry.enable()
+        tracing.seed_ids(3)
+        # 12 observations into ONE bin: the reservoir keeps at most
+        # EXEMPLARS_PER_BIN, deterministically, and counts the rest.
+        for _ in range(12):
+            telemetry.observe("query_s", 0.5, trace=tracing.new_trace())
+        (h,) = telemetry.snapshot()["histograms"].values()
+        assert h["exemplars_seen"] == 12
+        (entries,) = h["exemplars"].values()
+        assert len(entries) == telemetry.EXEMPLARS_PER_BIN
+        assert h["exemplars_dropped"] == 12 - telemetry.EXEMPLARS_PER_BIN
+        for e in entries:
+            assert re.fullmatch(r"[0-9a-f]{16}", e["trace_id"])
+            assert e["value"] == 0.5
+
+    def test_selection_is_deterministic(self):
+        def ids(snap):
+            (h,) = snap["histograms"].values()
+            return {
+                k: [(e["trace_id"], e["value"]) for e in lst]
+                for k, lst in h["exemplars"].items()
+            }
+
+        # Same seed -> the same traces survive the reservoir (wall_time
+        # is the only per-run field and is not part of the selection).
+        a = _traced_snapshot([0.5] * 10, seed=11)
+        b = _traced_snapshot([0.5] * 10, seed=11)
+        assert ids(a) == ids(b)
+
+    def test_merge_preserves_exemplars_assoc_comm(self):
+        """The fold is a bounded bottom-k under a fixed total order, so
+        grouping and order cannot change the result -- checked on three
+        real snapshots with overlapping bins."""
+        a = _traced_snapshot([0.01, 0.5, 0.5, 0.9], seed=1)
+        b = _traced_snapshot([0.011, 0.5, 2.5], seed=2)
+        c = _traced_snapshot([0.5, 0.9, 0.9, 7.0], seed=3)
+
+        def ex(m):
+            (h,) = m["histograms"].values()
+            return h["exemplars"]
+
+        m_abc = telemetry.merge_snapshots(a, b, c)
+        m_cab = telemetry.merge_snapshots(c, a, b)
+        m_bca = telemetry.merge_snapshots(b, c, a)
+        assert ex(m_abc) == ex(m_cab) == ex(m_bca)
+        left = telemetry.merge_snapshots(
+            telemetry.merge_snapshots(a, b), c
+        )
+        right = telemetry.merge_snapshots(
+            a, telemetry.merge_snapshots(b, c)
+        )
+        assert ex(left) == ex(right) == ex(m_abc)
+        # The union landed: every merged bin's entries came from the
+        # operands, and single-copy bins survived verbatim.
+        operand_ids = {
+            e["trace_id"]
+            for s in (a, b, c)
+            for lst in ex(s).values()
+            for e in lst
+        }
+        merged_ids = {
+            e["trace_id"] for lst in ex(m_abc).values() for e in lst
+        }
+        assert merged_ids <= operand_ids
+
+    def test_merge_drop_accounting(self):
+        a = _traced_snapshot([0.5] * 6, seed=4)
+        b = _traced_snapshot([0.5] * 6, seed=5)
+        (h,) = telemetry.merge_snapshots(a, b)["histograms"].values()
+        assert h["exemplars_seen"] == 12
+        kept = sum(len(v) for v in h["exemplars"].values())
+        assert kept <= telemetry.EXEMPLARS_PER_BIN
+        assert h["exemplars_dropped"] == h["exemplars_seen"] - kept
+
+    def test_exemplars_for_answers_the_p99_bin(self):
+        telemetry.enable()
+        tracing.seed_ids(9)
+        slow_ids = set()
+        for _ in range(95):
+            telemetry.observe("query_s", 0.001, trace=tracing.new_trace())
+        for _ in range(5):
+            slow = tracing.new_trace()
+            slow_ids.add(slow.trace_hex)
+            telemetry.observe("query_s", 0.9, trace=slow)
+        found = telemetry.exemplars_for(
+            telemetry.snapshot(), "query_s", 0.99
+        )
+        assert found["exemplars"]
+        assert {e["trace_id"] for e in found["exemplars"]} <= slow_ids
+        assert found["bin_value"] == pytest.approx(0.9, rel=0.05)
+
+    def test_exemplars_for_unknown_metric_refused(self):
+        telemetry.enable()
+        with pytest.raises(SketchValueError):
+            telemetry.exemplars_for(telemetry.snapshot(), "no.such_s")
+
+    def test_prometheus_exemplar_conformance_parse_back(self):
+        """Every quantile line with an exemplar annotation must parse
+        as ``name{...,quantile="q"} value # {trace_id="hex16"} value
+        timestamp`` and point at a recorded trace id."""
+        telemetry.enable()
+        tracing.seed_ids(21)
+        minted = set()
+        for v in (0.001, 0.002, 0.01, 0.2, 0.2, 0.9):
+            ctx = tracing.new_trace()
+            minted.add(ctx.trace_hex)
+            telemetry.observe("query_s", v, trace=ctx)
+        text = telemetry.prometheus_text()
+        pat = re.compile(
+            r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'\{(?P<labels>[^}]*quantile="[^"]+"[^}]*)\}'
+            r' (?P<value>[0-9.eE+-]+)'
+            r' # \{trace_id="(?P<trace>[0-9a-f]{16})"\}'
+            r' (?P<exval>[0-9.eE+-]+) (?P<ts>[0-9.]+)$'
+        )
+        annotated = [
+            ln for ln in text.splitlines() if " # {trace_id=" in ln
+        ]
+        assert annotated, "no exemplar annotation in the exposition"
+        for ln in annotated:
+            m = pat.match(ln)
+            assert m is not None, f"unparseable exemplar line: {ln!r}"
+            assert m.group("trace") in minted
+            assert float(m.group("exval")) > 0
+        # Exemplar-free expositions still parse: nothing else changed.
+        assert any(ln.endswith("_count 6") for ln in text.splitlines())
+
+
+# ---------------------------------------------------------------------------
+# (e) Chrome trace: declared pid scheme, labeled tracks, flow events
+# ---------------------------------------------------------------------------
+
+
+class TestChromeTrace:
+    def test_pid_scheme_declared_and_collision_free(self):
+        assert telemetry.CHROME_PID_SPANS != telemetry.CHROME_PID_DEVICE
+
+    def test_every_track_is_labeled(self):
+        from sketches_tpu import profiling
+
+        telemetry.enable()
+        profiling.enable()
+        profiling.reset()
+        sk = BatchedDDSketch(4, spec=SPEC)
+        sk.add(np.ones((4, 8), np.float32))
+        sk.get_quantile_values([0.5])
+        doc = telemetry.chrome_trace()
+        profiling.enable(False)
+        events = doc["traceEvents"]
+        named_pids = {
+            e["pid"] for e in events if e.get("name") == "process_name"
+        }
+        named_tids = {
+            (e["pid"], e["tid"])
+            for e in events
+            if e.get("name") == "thread_name"
+        }
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert xs, "workload produced no span events"
+        for e in xs:
+            assert e["pid"] in named_pids
+            assert (e["pid"], e["tid"]) in named_tids
+        assert telemetry.CHROME_PID_SPANS in named_pids
+        assert telemetry.CHROME_PID_DEVICE in named_pids
+
+    def test_trace_linked_spans_emit_flow_events(self):
+        telemetry.enable()
+        root = tracing.new_trace()
+        t0 = telemetry.clock()
+        telemetry.finish_span("query_s", t0, trace=root)
+        child = tracing.child_span(root)
+        telemetry.finish_span("ingest_s", telemetry.clock(), trace=child)
+        events = telemetry.chrome_trace()["traceEvents"]
+        starts = [e for e in events if e.get("ph") == "s"]
+        ends = [e for e in events if e.get("ph") == "f"]
+        assert len(starts) == 1 and len(ends) == 1
+        assert starts[0]["id"] == ends[0]["id"] == child.span_hex
+        assert ends[0]["bp"] == "e"
+        # The span events themselves carry the ids.
+        xs = {e["name"]: e for e in events if e.get("ph") == "X"}
+        assert xs["query_s"]["args"]["trace_id"] == root.trace_hex
+        assert xs["ingest_s"]["args"]["parent_id"] == root.span_hex
+
+    def test_untraced_spans_emit_no_flows(self):
+        telemetry.enable()
+        tracing.enable(False)
+        telemetry.finish_span("query_s", telemetry.clock())
+        events = telemetry.chrome_trace()["traceEvents"]
+        assert not [e for e in events if e.get("ph") in ("s", "f")]
+
+
+# ---------------------------------------------------------------------------
+# (f) Forensic bundles: auto-triggers + explain
+# ---------------------------------------------------------------------------
+
+
+class TestForensics:
+    def test_bundle_shape_and_bounded_ring(self):
+        telemetry.enable()
+        tracing.record_event("warmup")
+        for i in range(tracing.BUNDLE_CAPACITY + 3):
+            tracing.dump_forensics(f"reason-{i}")
+        bs = tracing.bundles()
+        assert len(bs) == tracing.BUNDLE_CAPACITY
+        assert tracing.stats()["bundles_dropped"] == 3
+        b = tracing.last_bundle()
+        assert b["format"] == "sketches_tpu.forensics/1"
+        for section in ("events", "telemetry", "slo", "health",
+                        "integrity", "trigger"):
+            assert section in b
+
+    def test_dump_writes_json_file(self, tmp_path):
+        p = tmp_path / "bundle.json"
+        tracing.dump_forensics("unit", path=str(p))
+        doc = json.loads(p.read_text())
+        assert doc["reason"] == "unit"
+
+    def test_cache_poison_auto_dumps_naming_the_entry(self):
+        telemetry.enable()
+        srv = _server()
+        srv.query("a", [0.9])
+        faults.arm(faults.SERVE_CACHE_POISON, times=1)
+        srv.query("a", [0.9])
+        faults.disarm()
+        poison = [
+            b for b in tracing.bundles()
+            if b["reason"] == "serve.cache_poison"
+        ]
+        assert len(poison) == 1
+        detail = poison[0]["trigger"]["detail"]
+        assert detail["tenant"] == "a"
+        assert detail["quantiles"] == "0.9"
+        assert re.fullmatch(r"[0-9a-f]{16}", detail["fingerprint"])
+        # The recorder saw the poison event on the victim's trace.
+        kinds = [e["kind"] for e in poison[0]["events"]]
+        assert "serve.cache.poisoned" in kinds
+
+    def test_unstructured_serve_error_auto_dumps(self, monkeypatch):
+        telemetry.enable()
+        srv = _server()
+
+        def broken(*a, **k):
+            raise SketchValueError("internal invariant broke")
+
+        monkeypatch.setattr(srv, "_cache_get", broken)
+        with pytest.raises(SketchValueError):
+            srv.submit("a", (0.5,))
+        assert tracing.last_bundle()["reason"] == "serve.submit"
+
+    def test_structured_refusals_do_not_dump(self):
+        telemetry.enable()
+        vc = VirtualClock()
+        srv = _server(clock=vc, max_queue_depth=1, tenant_quota=1)
+        srv.submit("a", (0.5,))
+        with pytest.raises(serve.ServeOverload):
+            srv.submit("a", (0.6,))
+        with pytest.raises(serve.DeadlineExceeded):
+            srv.submit("a", (0.7,), deadline_s=0.0)
+        assert tracing.last_bundle() is None
+
+    def test_slo_burn_auto_dumps_with_exemplar_trigger(self, tmp_path):
+        telemetry.enable()
+        tracing.seed_ids(5)
+        slow = tracing.new_trace()
+        for _ in range(50):
+            telemetry.observe("query_s", 0.001, trace=tracing.new_trace())
+        for _ in range(50):
+            telemetry.observe("query_s", 0.9, trace=slow)
+        snap_path = tmp_path / "burning.json"
+        snap_path.write_text(json.dumps(telemetry.snapshot()))
+        assert telemetry.main(["--check-slo", str(snap_path)]) == 1
+        bundle = json.loads((tmp_path / "burning.json.forensics.json")
+                            .read_text())
+        assert bundle["reason"] == "slo-burn"
+        assert bundle["trigger"]["trace"]["trace_id"] == slow.trace_hex
+        assert bundle["slo"]["burning"] >= 1
+
+    def test_clean_slo_gate_dumps_nothing(self, tmp_path):
+        telemetry.enable()
+        telemetry.observe("query_s", 0.001)
+        snap_path = tmp_path / "clean.json"
+        snap_path.write_text(json.dumps(telemetry.snapshot()))
+        assert telemetry.main(["--check-slo", str(snap_path)]) == 0
+        assert not (tmp_path / "clean.json.forensics.json").exists()
+
+    def test_explain_reconstructs_the_causal_chain(self):
+        telemetry.enable()
+        srv = _server()
+        ticket = srv.submit("a", (0.5, 0.99))
+        srv.flush()
+        assert ticket.trace is not None
+        bundle = tracing.dump_forensics("drill", trace=ticket.trace)
+        lines, n = tracing.explain(bundle, ticket.trace.trace_hex)
+        assert n >= 3
+        text = "\n".join(lines)
+        # Admission -> cache decision -> resolved engine tier, in order.
+        assert text.index("serve.submit") < text.index("serve.cache.miss")
+        assert text.index("serve.cache.miss") < text.index("engine.query")
+        assert "this is the triggering trace" in lines[0]
+        # "trigger" follows the bundle's own trace; ints work too.
+        assert tracing.explain(bundle, "trigger")[1] == n
+        assert tracing.explain(bundle, ticket.trace.trace_id)[1] == n
+
+    def test_explain_unknown_trace_and_malformed_bundle(self):
+        bundle = tracing.dump_forensics("empty")
+        lines, n = tracing.explain(bundle, "deadbeefdeadbeef")
+        assert n == 0 and len(lines) == 2
+        with pytest.raises(SketchValueError):
+            tracing.explain({"not": "a bundle"}, "0")
+
+
+# ---------------------------------------------------------------------------
+# The chaos drill: seeded campaign -> bundle -> explain, end to end
+# ---------------------------------------------------------------------------
+
+
+class TestChaosDrill:
+    @pytest.mark.slow
+    def test_serve_campaign_produces_explainable_bundles(self):
+        telemetry.enable()
+        tracing.seed_ids(0)
+        verdict = chaos.run_serve_campaign(steps=40, seed=3)
+        assert verdict["n_faults"] >= 1
+        assert verdict["forensics"]["events"] > 0
+        bundle = tracing.last_bundle()
+        assert bundle is not None
+        assert bundle["reason"].startswith("chaos.")
+        lines, n = tracing.explain(bundle, "trigger")
+        assert n >= 1
+        assert any("serve.submit" in ln for ln in lines)
+
+    def test_virtual_clock_drill_replays_exactly(self):
+        """The chaos-replay contract on ids: the same seeded drill under
+        a virtual serving clock records the same decision stream with
+        the same trace/span ids, run after run.  (The full campaign's
+        hedge decisions ride the wall clock, so id determinism is proven
+        here, on the clock-injected server.)"""
+
+        def drill():
+            telemetry.enable()
+            tracing.seed_ids(0)
+            tracing.configure(clock=VirtualClock(1e-4))
+            srv = _server(clock=VirtualClock(1e-4))
+            for q in (0.5, 0.9, 0.99):
+                srv.submit("a", (q,))
+            srv.flush()
+            faults.arm(faults.SERVE_CACHE_POISON, times=1)
+            srv.query("a", (0.5,))
+            srv.query("a", (0.5,))
+            faults.disarm()
+            stream = [
+                (e["kind"], e["trace_id"], e["span_id"], e["parent_id"])
+                for e in tracing.events()
+            ]
+            telemetry.disable()
+            telemetry.reset()
+            tracing.reset()
+            return stream
+
+        first = drill()
+        assert first  # the drill recorded a real decision stream
+        assert first == drill()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+class TestCli:
+    def _bundle_file(self, tmp_path):
+        telemetry.enable()
+        srv = _server()
+        ticket = srv.submit("a", (0.5,))
+        srv.flush()
+        p = tmp_path / "bundle.json"
+        tracing.dump_forensics("cli", trace=ticket.trace, path=str(p))
+        return p, ticket.trace
+
+    def test_explain_exit_codes(self, tmp_path, capsys):
+        p, ctx = self._bundle_file(tmp_path)
+        assert tracing.main(["--explain", str(p), ctx.trace_hex]) == 0
+        assert "serve.submit" in capsys.readouterr().out
+        assert tracing.main(["--explain", str(p), "trigger"]) == 0
+        assert tracing.main(
+            ["--explain", str(p), "deadbeefdeadbeef"]
+        ) == 1
+        assert tracing.main(
+            ["--explain", str(tmp_path / "missing.json"), "trigger"]
+        ) == 2
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert tracing.main(["--explain", str(bad), "trigger"]) == 2
+
+    def test_exemplars_query(self, tmp_path, capsys):
+        telemetry.enable()
+        tracing.seed_ids(13)
+        ctx = tracing.new_trace()
+        telemetry.observe("query_s", 0.25, trace=ctx)
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps(telemetry.snapshot()))
+        assert tracing.main(
+            ["--exemplars", str(snap), "query_s", "--q", "0.5"]
+        ) == 0
+        assert ctx.trace_hex in capsys.readouterr().out
+        assert tracing.main(
+            ["--exemplars", str(snap), "no.such_s"]
+        ) == 2
+
+    def test_dump_and_usage(self, tmp_path):
+        out = tmp_path / "live.json"
+        assert tracing.main(["--dump", str(out), "--reason", "drill"]) == 0
+        assert json.loads(out.read_text())["reason"] == "drill"
+        assert tracing.main([]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Snapshot integration
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotIntegration:
+    def test_recorder_stats_ride_armed_snapshots_and_merge(self):
+        telemetry.enable()
+        tracing.record_event("one")
+        snap = telemetry.snapshot()
+        assert snap["tracing"]["recorded"] == 1
+        merged = telemetry.merge_snapshots(snap, snap)
+        assert merged["tracing"]["recorded"] == 2
+        assert merged["tracing"]["capacity"] == snap["tracing"]["capacity"]
+
+    def test_declared_tracing_counters_bump(self):
+        telemetry.enable()
+        tracing.new_trace()
+        tracing.record_event("one")
+        counters = telemetry.snapshot()["counters"]
+        assert counters["tracing.traces"] == 1.0
+        assert counters["tracing.events"] == 1.0
+
+    def test_span_mirrors_into_recorder_with_trace(self):
+        telemetry.enable()
+        ctx = tracing.new_trace()
+        with tracing.use(ctx):
+            t0 = telemetry.clock()
+            telemetry.finish_span("query_s", t0, tier="xla")
+        (ev,) = [e for e in tracing.events() if e["kind"] == "span"]
+        assert ev["trace_id"] == ctx.trace_hex
+        assert ev["name"] == "query_s"
