@@ -1,10 +1,18 @@
-"""Example: mesh-sharded ingest + collective merge with DistributedDDSketch.
+"""Example: elastic mesh drill — kill a shard mid-ingest, regrow the mesh.
 
 Each device on the mesh ingests a different chunk of every stream's values
 into a per-device partial histogram; queries fold the partials with one
 ``lax.psum`` — the DDSketch ``merge()`` as an XLA collective riding
-ICI/DCN.  On a machine without 8 accelerators this provisions a virtual
-8-device CPU mesh (set env before jax import), so it runs anywhere:
+ICI/DCN.  Because every partial is itself an exact sketch (full
+mergeability), the fleet is *elastic*: this drill ingests, KILLS a value
+shard mid-stream, regrows onto a LARGER mesh with exact per-stream mass
+accounting (the dead shard's mass itemized, the survivors' fold verified
+by the integrity layer's merge-additive fingerprints), keeps ingesting,
+then SHRINKS the mesh — all without violating the alpha contract on the
+surviving mass.
+
+On a machine without 8 accelerators this provisions a virtual 8-device
+CPU mesh (set env before jax import), so it runs anywhere:
 
     python examples/distributed_mesh.py
 """
@@ -14,7 +22,16 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_SELF_PROVISIONED = __name__ == "__main__" and "JAX_PLATFORMS" not in os.environ
+_SELF_PROVISIONED = __name__ == "__main__" and (
+    "JAX_PLATFORMS" not in os.environ
+    # A pinned single-device CPU platform without the virtual-mesh flag
+    # would make the drill's grow/shrink vacuous; widen it to 8.
+    or (
+        os.environ["JAX_PLATFORMS"] == "cpu"
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")
+    )
+)
 if _SELF_PROVISIONED:
     # Provision a virtual 8-device CPU mesh when run standalone.
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -25,7 +42,6 @@ if _SELF_PROVISIONED:
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
 
 
 def main():
@@ -34,53 +50,117 @@ def main():
         # re-registers an accelerator platform at interpreter startup; the
         # runtime config must be forced too.
         jax.config.update("jax_platforms", "cpu")
-    from sketches_tpu.parallel import DistributedDDSketch
+    from sketches_tpu import faults, integrity
+    from sketches_tpu.parallel import DistributedDDSketch, SketchMesh
 
-    devices = jax.devices()
-    n = len(devices)
-    print(f"mesh: {n} x {devices[0].platform} devices")
+    n = len(jax.devices())
+    print(f"devices: {n} x {jax.devices()[0].platform}")
 
-    # 2-D mesh: stream axis (independent sketches, no comms) x value axis
-    # (same sketches, different value chunks, psum-merged at query time).
-    n_streams_axis = 2 if n % 2 == 0 else 1
-    mesh = Mesh(
-        np.asarray(devices).reshape(n_streams_axis, n // n_streams_axis),
-        ("streams", "values"),
-    )
+    # The reshard boundary must be PROVEN, not hoped: armed integrity
+    # verifies the fingerprint lane at every fold and reshard.
+    integrity.arm("raise")
 
-    n_streams = 64
+    n_streams, batch = 32, 512
+    k0 = min(4, n)
+    mesh = SketchMesh(k0, n_hosts=2 if k0 >= 2 else 1)
     dist = DistributedDDSketch(
-        n_streams,
-        mesh=mesh,
-        value_axis="values",
-        stream_axis="streams",
-        relative_accuracy=0.01,
-        n_bins=1024,
+        n_streams, mesh=mesh, relative_accuracy=0.01, n_bins=1024
     )
+    print(f"fleet: {mesh}")
 
     rng = np.random.default_rng(7)
-    all_values = []
-    for _step in range(5):
+    # Exact value ledger: a killed shard loses its WHOLE partial (every
+    # batch's column block since the mesh was built), so the drill
+    # tracks values per (stream, shard) for the current mesh epoch;
+    # folding an epoch moves the surviving shards' values into `kept`.
+    kept = [[] for _ in range(n_streams)]
+    epoch = [[[] for _ in range(dist.n_value_shards)]
+             for _ in range(n_streams)]
+
+    def ingest(d, steps):
         # values[i] is stream i's next chunk; the mesh splits the chunk
-        # across the value axis automatically.
-        values = rng.lognormal(3.0, 0.5, (n_streams, 512)).astype(np.float32)
-        dist.add(values)
-        all_values.append(values)
+        # across the value axis in contiguous column blocks, so the
+        # drill knows EXACTLY which values live on which shard.
+        k = d.n_value_shards
+        w = batch // k
+        for _ in range(steps):
+            values = rng.lognormal(3.0, 0.5, (n_streams, batch)).astype(
+                np.float32
+            )
+            d.add(values)
+            for i in range(n_streams):
+                for s in range(k):
+                    epoch[i][s].extend(values[i, s * w:(s + 1) * w])
+        return d
 
+    def end_epoch(d, dead=()):
+        # Fold the epoch's surviving shards into the flat ledger; the
+        # regrown fleet's slot-0 partial holds all of it.
+        for i in range(n_streams):
+            for s in range(len(epoch[i])):
+                if s not in dead:
+                    kept[i].extend(epoch[i][s])
+            epoch[i] = [[] for _ in range(d.n_value_shards)]
+
+    dist = ingest(dist, 5)
+
+    # --- kill a shard mid-ingest, regrow onto a LARGER mesh ------------
+    dead = 1
+    pre_count = np.asarray(dist.count, np.float64)
+    faults.arm(faults.MESH_SHARD, shards=(dead,))
+    try:
+        dist, report = dist.reshard(mesh=mesh.resized(min(8, n)))
+    finally:
+        faults.disarm()
+    end_epoch(dist, dead={dead})
+    print(
+        f"kill-and-regrow: {report.from_devices} -> {report.to_devices}"
+        f" devices, dead shards {report.dead_shards}"
+    )
+    print(
+        "  mass accounting: surviving"
+        f" {report.surviving_count.sum():.0f}, dropped"
+        f" {report.total_dropped:.0f}"
+        f" ({report.total_dropped_fraction:.1%}), itemized per stream:"
+        f" {report.dropped_count[:4]}..."
+    )
+    print(
+        f"  exact fold: {report.exact}, fingerprints match:"
+        f" {report.fingerprints_match}"
+    )
+    assert report.exact and report.fingerprints_match
+    assert report.surviving_count.sum() + report.total_dropped == \
+        pre_count.sum()
+
+    # --- keep serving on the regrown fleet, then SHRINK ----------------
+    dist = ingest(dist, 2)
+    dist, shrink = dist.reshard(n_devices=2)
+    end_epoch(dist)
+    print(
+        f"shrink: {shrink.from_devices} -> {shrink.to_devices} devices,"
+        f" exact={shrink.exact}, fingerprints"
+        f" match={shrink.fingerprints_match}"
+    )
+    assert shrink.exact and shrink.n_dead == 0
+
+    # --- the alpha contract holds on the SURVIVING mass ----------------
     qs = [0.5, 0.99]
-    got = np.asarray(dist.get_quantile_values(qs))  # one psum + one query
-    exact = np.concatenate(all_values, axis=1)
-
+    got = np.asarray(dist.get_quantile_values(qs))
     print(f"{'stream':>6} {'p50':>8} {'exact':>8} {'p99':>8} {'exact':>8}")
     for i in (0, n_streams - 1):
-        e50 = np.quantile(exact[i], 0.5, method="lower")
-        e99 = np.quantile(exact[i], 0.99, method="lower")
+        vals = np.asarray(kept[i], np.float64)
+        e50 = np.quantile(vals, 0.5, method="lower")
+        e99 = np.quantile(vals, 0.99, method="lower")
         print(
-            f"{i:>6} {got[i, 0]:>8.2f} {e50:>8.2f} {got[i, 1]:>8.2f} {e99:>8.2f}"
+            f"{i:>6} {got[i, 0]:>8.2f} {e50:>8.2f} {got[i, 1]:>8.2f}"
+            f" {e99:>8.2f}"
         )
         assert abs(got[i, 0] - e50) <= 0.0101 * e50
         assert abs(got[i, 1] - e99) <= 0.0101 * e99
-    print("distributed quantiles within the 1% contract")
+    print(
+        "elastic drill passed: exact mass accounting across"
+        " kill/regrow/shrink, quantiles within the 1% contract"
+    )
 
 
 if __name__ == "__main__":

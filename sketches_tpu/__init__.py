@@ -65,7 +65,7 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 
-__version__ = "0.12.0"
+__version__ = "0.13.0"
 
 __all__ = [
     "BaseDDSketch",

@@ -36,6 +36,7 @@ __all__ = [
     "ACCURACY_AUDIT",
     "SERVE_CACHE",
     "SERVE_HEDGE",
+    "ELASTIC",
     "FLIGHT_RECORDER",
     "REGISTRY",
     "declared",
@@ -172,6 +173,18 @@ FLIGHT_RECORDER = EnvVar(
     ),
 )
 
+#: Elastic-resharding kill switch (``sketches_tpu.parallel``).
+ELASTIC = EnvVar(
+    name="SKETCHES_TPU_ELASTIC",
+    default="1",
+    owner="sketches_tpu.parallel",
+    doc=(
+        "Set to 0 to refuse live elastic resharding"
+        " (DistributedDDSketch.reshard raises SpecError; the fleet"
+        " keeps its fixed topology -- checkpoint/restore still works)."
+    ),
+)
+
 #: Serving-tier hedged-retry kill switch (``sketches_tpu.serve``).
 SERVE_HEDGE = EnvVar(
     name="SKETCHES_TPU_SERVE_HEDGE",
@@ -191,7 +204,8 @@ REGISTRY: Dict[str, EnvVar] = {
     v.name: v
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
-        ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, FLIGHT_RECORDER,
+        ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
+        FLIGHT_RECORDER,
     )
 }
 

@@ -31,6 +31,16 @@ bookkeeping mismatch -- makes the campaign exit non-zero.  The whole
 campaign is seeded (``np.random.default_rng(seed)`` plus the fault
 plans' own seeds): a failing run replays exactly.
 
+``--campaign elastic`` runs the ELASTIC campaign: one live fleet keeps
+ingesting while shards and whole hosts are killed mid-stream and the
+mesh is regrown onto 1/2/4/8 devices (``mesh.shard`` /
+``mesh.host_loss`` / ``dcn.partition`` / ``reshard.torn``); every fault
+must be **detected** or **recovered** -- the survivors' fold carries the
+expected surviving mass bit-exactly, the dead capacity's mass is
+itemized per stream, torn reshards leave the original fleet intact, and
+the armed integrity layer's fingerprint lane verifies every reshard
+boundary.
+
 ``--campaign serve`` runs the SERVING campaign instead: a seeded Zipf
 tenant mix drives a :class:`sketches_tpu.serve.SketchServer` (ingest /
 query / batched flush) while the ``serve.*`` sites inject stragglers,
@@ -67,7 +77,12 @@ from sketches_tpu.resilience import (
     SketchValueError,
 )
 
-__all__ = ["run_campaign", "run_serve_campaign", "main"]
+__all__ = [
+    "run_campaign",
+    "run_serve_campaign",
+    "run_elastic_campaign",
+    "main",
+]
 
 #: Campaign shape: small enough that a 500+-step soak runs in CI
 #: minutes, big enough that every store/seam carries real mass.
@@ -667,6 +682,374 @@ def run_serve_campaign(steps: int, seed: int) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# Elastic campaign (kill-and-regrow across mesh sizes)
+# ---------------------------------------------------------------------------
+
+#: Elastic-campaign shape: small states, batch width divisible by every
+#: mesh size the campaign regrows onto (1/2/4/8).
+_ELASTIC_STREAMS = 8
+_ELASTIC_BATCH = 32
+
+
+def _elastic_sizes() -> List[int]:
+    """Mesh sizes the campaign cycles over: the 1/2/4/8 curve clipped to
+    the devices this process actually has (the CI job provisions an
+    8-device virtual CPU mesh; a 1-device host still soaks the
+    fold/accounting invariants, just without growth)."""
+    import jax
+
+    n = len(jax.devices())
+    return [k for k in (1, 2, 4, 8) if k <= n]
+
+
+@dataclasses.dataclass
+class _ElasticCampaign:
+    """Mutable elastic-campaign state: ONE live fleet that keeps being
+    killed and regrown, a 'remote host' batched partial for the DCN
+    fold, and the exact per-stream mass ledgers the verdict audits."""
+
+    spec: Any
+    fleet: Any  # the current DistributedDDSketch
+    remote: Any  # a BatchedDDSketch standing in for a second host
+    rng: Any
+    tmpdir: str
+    expected: Any = None  # np [N] f64: mass the LIVE fleet must hold
+    remote_expected: Any = None  # np [N] f64: the remote host's mass
+    dropped: Any = None  # np [N] f64: mass itemized lost to dead shards
+    reshards: int = 0
+    sizes_visited: Any = dataclasses.field(default_factory=set)
+    events: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+
+def _elastic_fleet_count(c: _ElasticCampaign) -> np.ndarray:
+    import jax
+
+    return np.asarray(
+        jax.device_get(c.fleet.merged_state().count), np.float64
+    )
+
+
+def _elastic_audit(c: _ElasticCampaign, where: str) -> bool:
+    """Exact per-stream mass accounting: the live fleet's fold must hold
+    EXACTLY the expected surviving mass (unit weights -> integer-valued
+    f32 counts, compared exactly)."""
+    got = _elastic_fleet_count(c)
+    if np.array_equal(got, c.expected):
+        return True
+    c.errors.append(
+        f"{where}: fleet mass {got.sum():g} != expected"
+        f" {c.expected.sum():g} (first bad stream"
+        f" {int(np.nonzero(got != c.expected)[0][0])})"
+    )
+    return False
+
+
+def _elastic_ingest(c: _ElasticCampaign, step: int) -> None:
+    vals = c.rng.lognormal(0.0, 0.5, (_ELASTIC_STREAMS, _ELASTIC_BATCH))
+    c.fleet.add(vals.astype(np.float32))
+    c.expected = c.expected + _ELASTIC_BATCH
+
+
+def _elastic_query(c: _ElasticCampaign, step: int) -> None:
+    q = np.asarray(c.fleet.get_quantile_values(list(_QS)))
+    live = q[_elastic_fleet_count(c) > 0]
+    if live.size and not np.isfinite(live).all():
+        raise SketchError("elastic query returned non-finite quantiles")
+
+
+def _elastic_remote_ingest(c: _ElasticCampaign, step: int) -> None:
+    vals = c.rng.lognormal(0.0, 0.5, (_ELASTIC_STREAMS, _ELASTIC_BATCH))
+    c.remote.add(vals.astype(np.float32))
+    c.remote_expected = c.remote_expected + _ELASTIC_BATCH
+
+
+def _elastic_reshard(c: _ElasticCampaign, step: int) -> None:
+    """Clean grow/shrink: regrow onto the next seeded mesh size with
+    ZERO lost mass (report must say exact, no dead shards)."""
+    from sketches_tpu.parallel import SketchMesh
+
+    sizes = _elastic_sizes()
+    k = int(sizes[int(c.rng.integers(len(sizes)))])
+    fleet, report = c.fleet.reshard(
+        mesh=SketchMesh(k, n_hosts=2 if k >= 2 else 1)
+    )
+    c.fleet = fleet
+    c.reshards += 1
+    c.sizes_visited.add(k)
+    if report.n_dead or not report.exact:
+        raise SketchError(
+            f"clean reshard to {k} devices reported n_dead="
+            f"{report.n_dead} exact={report.exact}"
+        )
+    _elastic_audit(c, f"step {step} reshard->{k}")
+
+
+def _elastic_checkpoint(c: _ElasticCampaign, step: int) -> None:
+    """Partials checkpoint -> restore onto a DIFFERENT mesh size; the
+    restored fold must carry the exact expected mass."""
+    from sketches_tpu import checkpoint
+    from sketches_tpu.parallel import SketchMesh
+
+    sizes = _elastic_sizes()
+    k = int(sizes[int(c.rng.integers(len(sizes)))])
+    path = os.path.join(c.tmpdir, "elastic.ckpt")
+    checkpoint.save(path, c.fleet, partials=True)
+    c.fleet = checkpoint.restore_distributed(
+        path, mesh=SketchMesh(k, n_hosts=2 if k >= 2 else 1)
+    )
+    c.sizes_visited.add(k)
+    _elastic_audit(c, f"step {step} ckpt-restore->{k}")
+
+
+_ELASTIC_OPS = (
+    _elastic_ingest, _elastic_query, _elastic_remote_ingest,
+    _elastic_reshard, _elastic_checkpoint,
+)
+_ELASTIC_OP_WEIGHTS = (0.45, 0.15, 0.1, 0.2, 0.1)
+
+
+def _elastic_fault_shard(c: _ElasticCampaign, step: int) -> str:
+    """Kill one value shard mid-stream, regrow onto a different mesh
+    size: the survivors' fold must be exact and the dead shard's mass
+    itemized per stream -- 'recovered', anything else undetected."""
+    import jax
+
+    from sketches_tpu.parallel import SketchMesh
+
+    k_now = c.fleet.n_value_shards
+    if k_now < 2:
+        return "skipped"
+    dead = int(c.rng.integers(k_now))
+    part_counts = np.asarray(
+        jax.device_get(c.fleet.partials.count), np.float64
+    )
+    sizes = _elastic_sizes()
+    k_next = int(sizes[int(c.rng.integers(len(sizes)))])
+    with faults.active({faults.MESH_SHARD: dict(shards=(dead,))}):
+        fleet, report = c.fleet.reshard(
+            mesh=SketchMesh(k_next, n_hosts=2 if k_next >= 2 else 1)
+        )
+    c.fleet = fleet
+    c.reshards += 1
+    c.sizes_visited.add(k_next)
+    if report.dead_shards != [dead] or not report.exact:
+        return "undetected"
+    if not np.array_equal(report.dropped_count, part_counts[dead]):
+        return "undetected"  # itemization must match the shard exactly
+    if report.fingerprints_match is False:
+        return "undetected"
+    c.expected = c.expected - report.dropped_count
+    c.dropped = c.dropped + report.dropped_count
+    return (
+        "recovered"
+        if _elastic_audit(c, f"step {step} kill-shard-{dead}->{k_next}")
+        else "undetected"
+    )
+
+
+def _elastic_fault_host_loss(c: _ElasticCampaign, step: int) -> str:
+    """Kill a whole host (every shard in one ICI group), regrow: same
+    exactness contract as a single dead shard, host itemized."""
+    import jax
+
+    from sketches_tpu.parallel import SketchMesh
+
+    if c.fleet.n_hosts < 2:
+        return "skipped"
+    host = int(c.rng.integers(c.fleet.n_hosts))
+    shards = list(c.fleet._host_shards(host))
+    part_counts = np.asarray(
+        jax.device_get(c.fleet.partials.count), np.float64
+    )
+    sizes = _elastic_sizes()
+    k_next = int(sizes[int(c.rng.integers(len(sizes)))])
+    with faults.active({faults.MESH_HOST_LOSS: dict(shards=(host,))}):
+        fleet, report = c.fleet.reshard(
+            mesh=SketchMesh(k_next, n_hosts=2 if k_next >= 2 else 1)
+        )
+    c.fleet = fleet
+    c.reshards += 1
+    c.sizes_visited.add(k_next)
+    if report.lost_hosts != (host,) or report.dead_shards != shards:
+        return "undetected"
+    if not report.exact or not np.array_equal(
+        report.dropped_count, part_counts[shards].sum(axis=0)
+    ):
+        return "undetected"
+    c.expected = c.expected - report.dropped_count
+    c.dropped = c.dropped + report.dropped_count
+    return (
+        "recovered"
+        if _elastic_audit(c, f"step {step} host-loss-{host}->{k_next}")
+        else "undetected"
+    )
+
+
+def _elastic_fault_partition(c: _ElasticCampaign, step: int) -> str:
+    """DCN partition at the cross-host fold: the unreachable host's
+    partial is folded AROUND with its mass accounted -- detected, never
+    silently zeroed.  Campaign state is untouched (the fold is a read)."""
+    from sketches_tpu.parallel import fold_hosts
+
+    before = resilience.health()["counters"].get("dcn.partitions", 0)
+    with faults.active({faults.DCN_PARTITION: dict(shards=(1,))}):
+        folded, report = fold_hosts(
+            c.spec, [c.fleet.merged_state(), c.remote.state]
+        )
+    got = np.asarray(folded.count, np.float64)
+    counted = resilience.health()["counters"].get("dcn.partitions", 0)
+    ok = (
+        report.n_dead == 1
+        and report.dead_shards == [1]
+        and np.array_equal(got, c.expected)
+        and np.array_equal(report.dropped_count, c.remote_expected)
+        and counted > before
+    )
+    return "detected" if ok else "undetected"
+
+
+def _elastic_fault_torn(c: _ElasticCampaign, step: int) -> str:
+    """A reshard torn between the survivor fold and the regrow must
+    raise AND leave the original fleet fully intact (atomic reshard)."""
+    sizes = _elastic_sizes()
+    k_next = int(sizes[int(c.rng.integers(len(sizes)))])
+    try:
+        with faults.active({faults.RESHARD_TORN: dict(times=1)}):
+            c.fleet.reshard(n_devices=k_next)
+        return "undetected"  # the tear did not surface
+    except InjectedFault:
+        pass
+    return (
+        "detected"
+        if _elastic_audit(c, f"step {step} torn-reshard->{k_next}")
+        else "undetected"
+    )
+
+
+_ELASTIC_FAULT_DRIVERS = {
+    faults.MESH_SHARD: _elastic_fault_shard,
+    faults.MESH_HOST_LOSS: _elastic_fault_host_loss,
+    faults.DCN_PARTITION: _elastic_fault_partition,
+    faults.RESHARD_TORN: _elastic_fault_torn,
+}
+
+
+def run_elastic_campaign(
+    steps: int,
+    seed: int,
+    mode: str = "raise",
+    tmpdir: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Run the seeded ELASTIC chaos campaign -> the verdict document.
+
+    One live fleet ingests while the campaign kills shards and whole
+    hosts mid-stream, regrows onto 1/2/4/8-device meshes (clipped to
+    the devices this process has), round-trips partials checkpoints
+    onto different mesh sizes, and crosses a simulated DCN fold --
+    with the integrity layer armed (``mode``) so every reshard
+    boundary's fingerprint lane verifies.  ``ok`` is True iff every
+    injected fault was ``detected`` or ``recovered`` (kill-and-regrow
+    with exact per-stream mass accounting: survivors' fold equals the
+    expected surviving mass bit-exactly, dropped mass itemized), the
+    final fold conserves the ledger, and no unexpected error escaped.
+    Raises ``SketchValueError`` for non-positive ``steps``;
+    campaign-level failures are reported, not raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+    from sketches_tpu.parallel import DistributedDDSketch, SketchMesh
+
+    was_active, was_mode = integrity.enabled(), integrity.mode()
+    faults.disarm()
+    integrity.arm(mode)
+    own_tmp = None
+    if tmpdir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="sketches_elastic_")
+        tmpdir = own_tmp.name
+    try:
+        spec = SketchSpec(relative_accuracy=_REL_ACC, n_bins=_N_BINS)
+        sizes = _elastic_sizes()
+        k0 = sizes[-1] if len(sizes) > 1 else sizes[0]
+        c = _ElasticCampaign(
+            spec=spec,
+            fleet=DistributedDDSketch(
+                _ELASTIC_STREAMS, spec=spec,
+                mesh=SketchMesh(k0, n_hosts=2 if k0 >= 2 else 1),
+            ),
+            remote=BatchedDDSketch(_ELASTIC_STREAMS, spec=spec),
+            rng=np.random.default_rng(seed),
+            tmpdir=tmpdir,
+            expected=np.zeros((_ELASTIC_STREAMS,), np.float64),
+            remote_expected=np.zeros((_ELASTIC_STREAMS,), np.float64),
+            dropped=np.zeros((_ELASTIC_STREAMS,), np.float64),
+        )
+        c.sizes_visited.add(k0)
+        fault_sites = tuple(_ELASTIC_FAULT_DRIVERS)
+        for step in range(steps):
+            op = c.rng.choice(len(_ELASTIC_OPS), p=_ELASTIC_OP_WEIGHTS)
+            try:
+                _ELASTIC_OPS[op](c, step)
+            except Exception as e:  # un-faulted op must not fail
+                c.errors.append(
+                    f"step {step} op {_ELASTIC_OPS[op].__name__}: {e!r}"
+                )
+                break
+            if c.rng.random() < _FAULT_P:
+                site = fault_sites[int(c.rng.integers(len(fault_sites)))]
+                try:
+                    outcome = _ELASTIC_FAULT_DRIVERS[site](c, step)
+                except Exception as e:
+                    outcome = "undetected"
+                    c.errors.append(f"step {step} site {site}: {e!r}")
+                if outcome != "skipped":
+                    c.events.append(
+                        {"step": step, "site": site, "outcome": outcome}
+                    )
+                    _classify_forensics(site, outcome, step)
+        # Final audit: surviving mass exact, dropped mass itemized --
+        # every ingested value is either in the fleet or in the ledger.
+        conserved = _elastic_audit(c, "final")
+        outcomes: Dict[str, int] = {}
+        for ev in c.events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        ok = (
+            conserved
+            and not c.errors
+            and outcomes.get("undetected", 0) == 0
+        )
+        return {
+            "campaign": "elastic",
+            "steps": steps,
+            "seed": seed,
+            "mode": mode,
+            "ok": ok,
+            "n_faults": len(c.events),
+            "outcomes": outcomes,
+            "events": c.events,
+            "errors": c.errors,
+            "reshards": c.reshards,
+            "mesh_sizes_visited": sorted(int(k) for k in c.sizes_visited),
+            "expected_count": float(c.expected.sum()),
+            "final_count": float(_elastic_fleet_count(c).sum()),
+            "dropped_count": float(c.dropped.sum()),
+            "integrity_reports": len(integrity.reports()),
+            "health": resilience.health(),
+            "forensics": tracing.stats() if tracing.enabled() else None,
+            "telemetry": telemetry.snapshot() if telemetry.enabled() else None,
+        }
+    finally:
+        faults.disarm()
+        if was_active:
+            integrity.arm(was_mode)
+        else:
+            integrity.disarm()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry point: run the campaign, write the verdict, exit 0 iff
     every injected fault was accounted for (1 otherwise).
@@ -686,10 +1069,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--campaign", choices=("core", "serve"), default="core",
+        "--campaign", choices=("core", "serve", "elastic"), default="core",
         help="core: the integrity soak over the storage/engine sites;"
         " serve: the serving-tier soak over the serve.* sites (every"
-        " fault shed, hedged, or detected)",
+        " fault shed, hedged, or detected); elastic: the kill-and-regrow"
+        " soak over the mesh.shard/mesh.host_loss/dcn.partition/"
+        "reshard.torn sites across 1/2/4/8-device meshes (every fault"
+        " detected or recovered with exact mass accounting)",
     )
     parser.add_argument(
         "--mode", choices=("raise", "quarantine"), default="raise",
@@ -716,6 +1102,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.campaign == "serve":
         verdict = run_serve_campaign(args.steps, args.seed)
+    elif args.campaign == "elastic":
+        verdict = run_elastic_campaign(args.steps, args.seed, mode=args.mode)
     else:
         verdict = run_campaign(args.steps, args.seed, mode=args.mode)
     if args.out:

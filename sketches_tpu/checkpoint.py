@@ -222,13 +222,35 @@ def _restore_state_inner(path: str):
     return spec, state, stored_fp
 
 
-def save(path: str, sketch: Union[BatchedDDSketch, "DistributedDDSketch"]) -> None:  # noqa: F821
-    """Checkpoint a batched (or distributed -- folded first) sketch facade."""
+def save(
+    path: str,
+    sketch: Union[BatchedDDSketch, "DistributedDDSketch"],  # noqa: F821
+    partials: bool = False,
+) -> None:
+    """Checkpoint a batched (or distributed -- folded first) sketch facade.
+
+    ``partials=True`` (distributed facades only; ``SpecError``
+    otherwise) saves the STACKED ``[K, n_streams, ...]`` partials pytree
+    instead of the fold -- the elastic-resume format:
+    ``restore_distributed(..., live_mask=...)`` can then drop dead
+    shards at restore time with exact per-shard accounting, which a
+    folded checkpoint cannot (the shards are already summed).
+    """
     from sketches_tpu.parallel import DistributedDDSketch
 
     if isinstance(sketch, DistributedDDSketch):
-        save_state(path, sketch.spec, sketch.merged_state())
+        if partials:
+            save_state(path, sketch.spec, sketch.partials)
+        else:
+            save_state(path, sketch.spec, sketch.merged_state())
     else:
+        if partials:
+            from sketches_tpu.resilience import SpecError
+
+            raise SpecError(
+                "partials=True needs a DistributedDDSketch (a batched"
+                " facade has no shard axis)"
+            )
         save_state(path, sketch.spec, sketch.state)
 
 
@@ -246,6 +268,8 @@ def restore_distributed(
     value_axis="values",
     stream_axis=None,
     engine: str = "auto",
+    live_mask=None,
+    n_hosts=None,
 ):
     """Resume a checkpoint as a mesh-sharded ``DistributedDDSketch``.
 
@@ -254,8 +278,20 @@ def restore_distributed(
     value-shard 0's partial (the other shards hold the fold's
     identities), so the psum fold reproduces the saved totals exactly and
     subsequent ingest spreads new mass across shards as usual.  The
-    mesh/axes may differ from the mesh the checkpoint was written under
-    (the wire carries no topology -- state is topology-free by design).
+    mesh/axes may differ -- in SIZE too -- from the mesh the checkpoint
+    was written under (the wire carries no topology; state is
+    topology-free by design): this is the elastic resume path, and with
+    the integrity layer armed the checkpoint's embedded fingerprint is
+    re-verified on the restored state before the new fleet folds it.
+
+    A ``save(..., partials=True)`` checkpoint restores the stacked
+    partials instead; ``live_mask`` (a ``[K]`` bool) then drops dead
+    shards at restore time with their mass accounted
+    (``resilience.health()``), and a mask over a folded checkpoint
+    raises ``SketchValueError``.  A torn or corrupted file raises
+    ``CheckpointCorrupt`` -- an interrupted reshard can never silently
+    lose mass, because the previous checkpoint is still intact
+    (atomic writes) and a damaged one refuses to load.
     """
     from sketches_tpu.parallel import DistributedDDSketch
 
@@ -267,4 +303,6 @@ def restore_distributed(
         value_axis=value_axis,
         stream_axis=stream_axis,
         engine=engine,
+        live_mask=live_mask,
+        n_hosts=n_hosts,
     )

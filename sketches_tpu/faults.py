@@ -16,8 +16,21 @@ site                    simulates
 ``checkpoint.write``    a torn checkpoint write (``mode="truncate"``) or a
                         crash before the atomic rename (``mode="raise"``)
 ``mesh.shard``          dead value shard(s) -- consumed by
-                        ``DistributedDDSketch.merge_partial`` via
-                        :func:`dead_shards`
+                        ``DistributedDDSketch.merge_partial`` /
+                        ``reshard`` via :func:`dead_shards`
+``mesh.host_loss``      a whole lost host (every value shard in one ICI
+                        group dies at once) -- consumed by
+                        ``DistributedDDSketch.reshard`` via
+                        :func:`lost_hosts`
+``dcn.partition``       a DCN network partition: some process-local
+                        merged partials are unreachable at the
+                        cross-host fold -- consumed by
+                        ``parallel.fold_hosts`` via
+                        :func:`partitioned_hosts`
+``reshard.torn``        an elastic reshard interrupted between the
+                        survivor fold and the regrown mesh (raises at
+                        the reshard seam; the ORIGINAL fleet must
+                        survive intact -- reshard is atomic)
 ``state.bitflip``       silent device-state corruption: a bit flipped in a
                         bin vector -- consumed by the chaos harness via
                         :func:`state_bitflips` + :func:`apply_state_bitflips`
@@ -68,6 +81,9 @@ __all__ = [
     "WIRE_BLOB",
     "CHECKPOINT_WRITE",
     "MESH_SHARD",
+    "MESH_HOST_LOSS",
+    "DCN_PARTITION",
+    "RESHARD_TORN",
     "STATE_BITFLIP",
     "SERVE_STRAGGLER",
     "SERVE_CACHE_POISON",
@@ -78,6 +94,8 @@ __all__ = [
     "active",
     "inject",
     "dead_shards",
+    "lost_hosts",
+    "partitioned_hosts",
     "state_bitflips",
     "apply_state_bitflips",
     "cache_poison_flip",
@@ -95,6 +113,9 @@ PALLAS_INGEST = "pallas.ingest"
 WIRE_BLOB = "wire.blob"
 CHECKPOINT_WRITE = "checkpoint.write"
 MESH_SHARD = "mesh.shard"
+MESH_HOST_LOSS = "mesh.host_loss"
+DCN_PARTITION = "dcn.partition"
+RESHARD_TORN = "reshard.torn"
 STATE_BITFLIP = "state.bitflip"
 SERVE_STRAGGLER = "serve.straggler"
 SERVE_CACHE_POISON = "serve.cache_poison"
@@ -107,6 +128,9 @@ SITES = (
     WIRE_BLOB,
     CHECKPOINT_WRITE,
     MESH_SHARD,
+    MESH_HOST_LOSS,
+    DCN_PARTITION,
+    RESHARD_TORN,
     STATE_BITFLIP,
     SERVE_STRAGGLER,
     SERVE_CACHE_POISON,
@@ -268,6 +292,47 @@ def dead_shards(n_shards: int) -> Tuple[int, ...]:
         plan.fired += 1
         bump("faults." + MESH_SHARD)
     return dead
+
+
+def _armed_indices(site: str, n: int) -> Tuple[int, ...]:
+    """Shared consumer-side read for the index-set sites (``mesh.shard``
+    style): the armed plan's in-range ``shards`` indices, counted and
+    bumped when any fire.  Disarmed it returns ``()`` after one bool
+    test; an empty/out-of-range plan fires nothing."""
+    if not _ACTIVE:
+        return ()
+    plan = _plans.get(site)
+    if plan is None:
+        return ()
+    plan.calls += 1
+    hit = tuple(s for s in plan.shards if 0 <= s < n)
+    if hit:
+        plan.fired += 1
+        bump("faults." + site)
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "fault.injected", site=site, indices=str(hit)
+            )
+    return hit
+
+
+def lost_hosts(n_hosts: int) -> Tuple[int, ...]:
+    """Armed lost-host indices within ``[0, n_hosts)`` -- the
+    ``mesh.host_loss`` site's consumer-side read (returns data rather
+    than raising, like :func:`dead_shards`; every value shard in a lost
+    host's ICI group is treated as dead at the next reshard/fold).
+    Disarmed (the default) it returns ``()`` after one bool test."""
+    return _armed_indices(MESH_HOST_LOSS, n_hosts)
+
+
+def partitioned_hosts(n_hosts: int) -> Tuple[int, ...]:
+    """Armed DCN-partitioned host indices within ``[0, n_hosts)`` -- the
+    ``dcn.partition`` site's consumer-side read (returns data rather
+    than raising): those hosts' process-local merged partials are
+    unreachable at the cross-host fold and must be folded around with
+    their mass accounted, never silently averaged as zeros.  Disarmed
+    (the default) it returns ``()`` after one bool test."""
+    return _armed_indices(DCN_PARTITION, n_hosts)
 
 
 def state_bitflips(n_streams: int, n_bins: int) -> Tuple[Tuple[int, int, int, int], ...]:

@@ -85,6 +85,7 @@ __all__ = [
     "fingerprint",
     "fingerprint_host",
     "verify_fold",
+    "verify_reshard",
     "verify_restore",
     "premerge",
     "postmerge",
@@ -761,6 +762,34 @@ def verify_fold(
     _flag(report, bad, "fingerprint",
           lambda i: f"folded fingerprint {fp_fold[i]:g} != shard-lane sum"
           f" {fp_sum[i]:g}")
+    return _record(report, None)
+
+
+def verify_reshard(
+    spec, pre_fp, post_state, seam: str = "reshard"
+) -> IntegrityReport:
+    """The elastic-reshard boundary's fingerprint lane.
+
+    ``pre_fp`` is the surviving mass's fingerprint (the live partials'
+    shard-lane sum, or the folded survivors' fingerprint -- additive, so
+    the two are equal); the regrown fleet's folded state must carry the
+    SAME per-stream fingerprint, because a reshard moves mass across
+    topologies without changing content (fingerprints are keyed on
+    absolute bin keys -- topology- and recenter-free by construction).
+    Also invariant-checks the regrown state.  Violations raise
+    ``IntegrityError``/quarantine per the armed mode.
+    """
+    report = check_state(spec, post_state, seam=seam)
+    fp_post = fingerprint(spec, post_state)
+    pre = np.asarray(pre_fp, np.float64)
+    if pre.shape != fp_post.shape:
+        report.add(0, "fingerprint",
+                   "pre-reshard fingerprint has the wrong shape")
+    else:
+        bad = np.abs(fp_post - pre) > _FP_ATOL + _FP_RTOL * np.abs(pre)
+        _flag(report, bad, "fingerprint",
+              lambda i: f"resharded fingerprint {fp_post[i]:g} != surviving"
+              f" mass {pre[i]:g}")
     return _record(report, None)
 
 

@@ -19,6 +19,23 @@ becomes XLA collectives over ICI/DCN (SURVEY.md section 5, comm-backend row):
 * Both compose on a 2-D mesh ``(streams, values)``; multi-host extends the
   same mesh over DCN via ``jax.distributed.initialize`` + ``make_global_mesh``
   -- the collective code is identical (the JAX runtime routes ICI vs DCN).
+
+Elastic fleet (r14): the mesh itself is a rebuildable abstraction
+(:class:`SketchMesh` -- the GSPMD/NamedSharding pattern that scales from
+8 chips to superclusters without changing application code), the merge
+fold is HIERARCHICAL (``psum_merge`` over a tuple of value axes folds the
+inner ICI axis first, then the outer DCN axis; :func:`fold_hosts` is the
+serialize-and-ship variant over process-local merged partials), and the
+fleet can grow/shrink LIVE: :meth:`DistributedDDSketch.reshard` folds the
+surviving partials and regrows onto a different mesh size with exact
+per-stream mass accounting (:class:`~sketches_tpu.resilience.ReshardReport`)
+and -- when the integrity layer is armed -- merge-additive fingerprints
+verified at the reshard boundary.  Full mergeability is what buys all of
+this: any partition of the stream space folds back to the same answer, so
+shards can die, hosts can join, and the mesh can be resized without
+violating the alpha contract.  ``SKETCHES_TPU_ELASTIC=0`` refuses live
+resharding (``SpecError``); torn reshards (the ``reshard.torn`` fault
+site) leave the original fleet intact -- reshard is atomic.
 """
 
 from __future__ import annotations
@@ -52,7 +69,9 @@ from sketches_tpu.batched import (
     quantile,
     recenter,
 )
+from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import (
+    ReshardReport,
     ShardLossError,
     ShardLossReport,
     SketchValueError,
@@ -85,9 +104,12 @@ def _shard_map_unchecked(f, mesh, in_specs, out_specs):
 __all__ = [
     "default_mesh",
     "make_global_mesh",
+    "make_hierarchical_mesh",
+    "SketchMesh",
     "shard_streams",
     "psum_merge",
     "fold_live_partials",
+    "fold_hosts",
     "DistributedDDSketch",
 ]
 
@@ -189,6 +211,307 @@ def make_global_mesh(
     return default_mesh(axis_names, shape, devices=jax.devices())
 
 
+class SketchMesh:
+    """Rebuildable mesh abstraction: the GSPMD topology the fleet runs on.
+
+    A bare ``jax.sharding.Mesh`` is a fixed device array; a
+    ``SketchMesh`` remembers the LAYOUT POLICY -- which named axes
+    exist, how many stream shards, how hosts group the value shards --
+    so the same logical topology can be rebuilt at a different device
+    count (:meth:`resized`).  That is the elastic primitive:
+    :meth:`DistributedDDSketch.reshard` folds the fleet, resizes the
+    mesh, and regrows onto it without changing application code (the
+    NamedSharding pattern that scales from 8-chip pods to superclusters).
+
+    ``value_axis`` may be one name, ``None`` (pure stream parallelism),
+    or a TUPLE ``(dcn_axis, ici_axis)`` for the hierarchical two-level
+    fold (outer axis spans hosts, inner spans each host's local
+    devices).  ``n_hosts`` groups the value shards into contiguous ICI
+    groups -- derived from the devices' process indices on a real
+    multi-host job (devices are then sorted host-major), or passed
+    explicitly to SIMULATE the DCN boundary on a single-process virtual
+    mesh.  Raises ``SpecError`` for impossible layouts: more devices
+    than exist, indivisible stream/host sharding, both axes ``None``.
+    """
+
+    def __init__(
+        self,
+        n_devices: Optional[int] = None,
+        *,
+        value_axis="values",
+        stream_axis: Optional[str] = None,
+        stream_shards: int = 1,
+        n_hosts: Optional[int] = None,
+        devices=None,
+    ):
+        if devices is None:
+            devices = sorted(
+                jax.devices(), key=lambda d: (d.process_index, d.id)
+            )
+        else:
+            devices = list(devices)
+        if n_devices is None:
+            n_devices = len(devices)
+        if not 1 <= n_devices <= len(devices):
+            raise SpecError(
+                f"SketchMesh needs 1 <= n_devices <= {len(devices)}"
+                f" available devices; got {n_devices}"
+            )
+        vaxes = _value_axes(value_axis)
+        if len(vaxes) > 2:
+            raise SpecError(
+                "value_axis may be one axis name or an (outer, inner)"
+                f" pair; got {value_axis!r}"
+            )
+        if not vaxes and stream_axis is None:
+            raise SpecError(
+                "Need at least one of value_axis / stream_axis"
+            )
+        if stream_axis is None and stream_shards != 1:
+            raise SpecError(
+                f"stream_shards={stream_shards} needs a stream_axis"
+            )
+        if n_devices % max(stream_shards, 1):
+            raise SpecError(
+                f"{n_devices} devices do not divide into"
+                f" {stream_shards} stream shards"
+            )
+        self.devices = tuple(devices[:n_devices])
+        self.value_axis = vaxes[0] if len(vaxes) == 1 else (
+            tuple(vaxes) if vaxes else None
+        )
+        self.stream_axis = stream_axis
+        self.stream_shards = int(stream_shards)
+        n_value = n_devices // max(stream_shards, 1) if vaxes else 1
+        if n_hosts is None:
+            if vaxes:
+                procs = len({d.process_index for d in self.devices})
+                n_hosts = procs if (procs and n_value % procs == 0) else 1
+            else:
+                n_hosts = 1
+        if not vaxes and n_hosts != 1:
+            raise SpecError(
+                "host grouping applies to value shards; a stream-only"
+                " mesh has n_hosts=1"
+            )
+        if n_value % max(n_hosts, 1):
+            raise SpecError(
+                f"{n_value} value shards do not divide into"
+                f" {n_hosts} hosts"
+            )
+        self.n_hosts = int(n_hosts)
+        self.n_value_shards = int(n_value)
+
+    @property
+    def n_devices(self) -> int:
+        return len(self.devices)
+
+    def build(self) -> Mesh:
+        """Materialize the ``jax.sharding.Mesh`` (stream axis first,
+        then the value axis/axes, hosts outermost for a hierarchical
+        pair).  Never raises on a validated ``SketchMesh``."""
+        axes: list = []
+        shape: list = []
+        if self.stream_axis is not None:
+            axes.append(self.stream_axis)
+            shape.append(self.stream_shards)
+        vaxes = _value_axes(self.value_axis)
+        if len(vaxes) == 2:
+            axes += list(vaxes)
+            shape += [self.n_hosts, self.n_value_shards // self.n_hosts]
+        elif vaxes:
+            axes.append(vaxes[0])
+            shape.append(self.n_value_shards)
+        arr = np.asarray(self.devices).reshape(tuple(shape))
+        return Mesh(arr, tuple(axes))
+
+    def resized(self, n_devices: int, devices=None) -> "SketchMesh":
+        """The SAME layout policy at a different device count -- the
+        grow/shrink step of an elastic reshard.
+
+        Host grouping is kept when it still divides the new value-shard
+        count and collapses to one host otherwise (a shrunken fleet may
+        not span every host; the fold semantics are unchanged either
+        way).  Raises ``SpecError`` when the new count cannot satisfy
+        the layout (e.g. fewer devices than stream shards).
+        """
+        n_value = n_devices // max(self.stream_shards, 1)
+        n_hosts = (
+            self.n_hosts
+            if self.n_hosts >= 1 and n_value >= self.n_hosts
+            and n_value % self.n_hosts == 0
+            else 1
+        )
+        return SketchMesh(
+            n_devices,
+            value_axis=self.value_axis,
+            stream_axis=self.stream_axis,
+            stream_shards=self.stream_shards,
+            n_hosts=n_hosts,
+            devices=devices,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"SketchMesh(n_devices={self.n_devices},"
+            f" value_axis={self.value_axis!r},"
+            f" stream_axis={self.stream_axis!r},"
+            f" stream_shards={self.stream_shards},"
+            f" n_hosts={self.n_hosts})"
+        )
+
+
+def make_hierarchical_mesh(
+    n_hosts: Optional[int] = None,
+    value_axes: Sequence[str] = ("dcn", "ici"),
+    stream_axis: Optional[str] = None,
+    stream_shards: int = 1,
+    devices=None,
+) -> SketchMesh:
+    """A two-level value mesh for the hierarchical ICI/DCN fold.
+
+    The outer axis (``value_axes[0]``) spans hosts, the inner spans each
+    host's local devices; ``psum_merge`` over the pair folds the inner
+    (ICI) axis first so only per-host partials cross the outer (DCN)
+    boundary.  On a real multi-host job (``jax.distributed.initialize``
+    first) the grouping derives from device process indices; pass
+    ``n_hosts`` to simulate the DCN boundary on a single-process virtual
+    mesh.  Returns a :class:`SketchMesh` (pass it to
+    ``DistributedDDSketch`` directly, or ``.build()`` a raw ``Mesh``).
+    Raises ``SpecError`` on indivisible layouts.
+    """
+    return SketchMesh(
+        value_axis=tuple(value_axes),
+        stream_axis=stream_axis,
+        stream_shards=stream_shards,
+        n_hosts=n_hosts,
+        devices=devices,
+    )
+
+
+_RECENTER_JITS: dict = {}
+
+
+def _aligned_states(spec: SketchSpec, states, reach: np.ndarray):
+    """Bring per-host states onto one per-stream window (the cross-host
+    analog of ``DistributedDDSketch.merge``'s alignment): target = the
+    first REACHABLE host holding binned mass for that stream.  A no-op
+    shift for hosts that already agree; mass outside a moved window
+    collapses into the edge bins (the documented recenter contract)."""
+    fn = _RECENTER_JITS.get(spec)
+    if fn is None:
+        fn = _RECENTER_JITS[spec] = jax.jit(
+            functools.partial(recenter, spec)
+        )
+    offs = np.stack(
+        [np.asarray(jax.device_get(st.key_offset)) for st in states]
+    )  # [H, N]
+    binned = np.stack(
+        [
+            np.asarray(jax.device_get(st.count), np.float64)
+            - np.asarray(jax.device_get(st.zero_count), np.float64)
+            for st in states
+        ]
+    )
+    live_idx = np.nonzero(reach)[0]
+    target = offs[live_idx[0]].copy()
+    chosen = np.zeros(target.shape, bool)
+    for h in live_idx:
+        pick = (~chosen) & (binned[h] > 0)
+        target[pick] = offs[h][pick]
+        chosen |= pick
+    target_arr = jnp.asarray(target, jnp.int32)
+    return [
+        st if not reach[h] or (offs[h] == target).all()
+        else fn(st, target_arr)
+        for h, st in enumerate(states)
+    ]
+
+
+def fold_hosts(spec: SketchSpec, states, reachable=None):
+    """Cross-host (DCN) fold of process-local MERGED partials ->
+    ``(folded state, ShardLossReport over hosts)``.
+
+    The hierarchical fold's outer level as an explicit protocol: each
+    process psums its own value shards over ICI (``merged_state``),
+    ships ONE merged partial across DCN (wire blobs, checkpoint, or a
+    collective -- the state is topology-free), and this fold adds the
+    per-host partials elementwise.  Windows are aligned first (hosts
+    may have auto-centered differently), then the stack folds through
+    :func:`fold_live_partials` -- so the armed integrity layer's
+    fingerprint lane verifies the fold exactly like the in-mesh psum.
+
+    ``states`` is a sequence of equal-shape ``[n_streams, ...]`` states.
+    ``reachable`` is a ``[n_hosts]`` bool mask; ``None`` derives it from
+    the armed ``dcn.partition`` fault site and defaults to
+    all-reachable.  An unreachable host's mass is folded AROUND and
+    accounted in the report (``dcn.partitions`` health counter +
+    ``elastic.dcn_partitions`` metric) -- detected, never silently
+    zeroed; no host reachable raises ``ShardLossError``; an empty or
+    shape-mismatched ``states`` raises ``SketchValueError``.
+    """
+    n_hosts = len(states)
+    if n_hosts == 0:
+        raise SketchValueError("fold_hosts needs at least one host state")
+    shapes = {tuple(st.bins_pos.shape) for st in states}
+    if len(shapes) != 1:
+        raise SketchValueError(
+            f"fold_hosts needs equal-shape host states; got {shapes}"
+        )
+    if reachable is None:
+        reach = np.ones((n_hosts,), bool)
+        part = faults.partitioned_hosts(n_hosts) if faults._ACTIVE else ()
+        if part:
+            reach[list(part)] = False
+    else:
+        reach = np.asarray(reachable, bool).reshape(-1)
+        if reach.shape[0] != n_hosts:
+            raise SketchValueError(
+                f"reachable mask length {reach.shape[0]} != {n_hosts} hosts"
+            )
+    if not reach.any():
+        raise ShardLossError(
+            f"all {n_hosts} hosts unreachable across DCN; nothing to fold"
+        )
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    aligned = _aligned_states(spec, states, reach)
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *aligned)
+    folded = fold_live_partials(spec, stacked, reach)
+    counts = np.stack(
+        [
+            np.asarray(jax.device_get(st.count), np.float64)
+            for st in aligned
+        ]
+    )
+    report = ShardLossReport(
+        live=reach,
+        surviving_count=counts[reach].sum(0),
+        dropped_count=counts[~reach].sum(0),
+    )
+    if not reach.all():
+        n_part = int((~reach).sum())
+        resilience.bump("dcn.partitions", n_part)
+        resilience.record_downgrade(
+            "distributed.dcn",
+            f"{n_hosts} hosts",
+            f"{int(reach.sum())} hosts",
+            f"DCN partition at the cross-host fold: hosts"
+            f" {report.dead_shards} unreachable; dropped"
+            f" {report.total_dropped_fraction:.4f} of total mass",
+        )
+        if telemetry._ACTIVE:
+            telemetry.counter_inc("elastic.dcn_partitions", float(n_part))
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "elastic.dcn_partition",
+                hosts=str(report.dead_shards),
+                n_hosts=n_hosts,
+            )
+    if _t0 is not None:
+        telemetry.finish_span("elastic.dcn_fold_s", _t0)
+    return folded, report
+
+
 def shard_streams(
     state: SketchState, mesh: Mesh, axis_name: str = "streams"
 ) -> SketchState:
@@ -204,13 +527,54 @@ def shard_streams(
     )
 
 
-def psum_merge(state: SketchState, axis_name: str) -> SketchState:
+def _value_axes(value_axis) -> tuple:
+    """Normalize a value-axis spec (None / one name / tuple of names,
+    outer->inner) to a tuple of mesh axis names; empty means no value
+    parallelism.  Never raises."""
+    if value_axis is None:
+        return ()
+    if isinstance(value_axis, (tuple, list)):
+        return tuple(value_axis)
+    return (value_axis,)
+
+
+def _pmax_axes(x, axes):
+    """``lax.pmax`` chained innermost-axis-first over ``axes`` (the
+    hierarchical-fold order; a single axis degenerates to one pmax)."""
+    for ax in reversed(axes):
+        x = lax.pmax(x, ax)
+    return x
+
+
+def _psum_axes(x, axes):
+    """``lax.psum`` chained innermost-axis-first over ``axes``."""
+    for ax in reversed(axes):
+        x = lax.psum(x, ax)
+    return x
+
+
+def psum_merge(state: SketchState, axis_name) -> SketchState:
     """Collective form of ``merge``: fold per-device partials over a mesh axis.
 
     Must run inside ``shard_map`` (or pmap).  The reference's
     ``DenseStore.merge`` offset-alignment loop is gone -- a shared static
     window makes the whole merge one ``psum`` (+ pmin/pmax for bounds).
+
+    ``axis_name`` may be one mesh axis or a TUPLE of axes listed
+    outer->inner (e.g. ``("dcn", "ici")``): the fold is then
+    HIERARCHICAL -- the innermost (ICI) axis reduces first, so each host
+    folds its local shards over the fast interconnect and only the
+    per-host partials cross the outer (DCN) boundary.  XLA lowers the
+    chain to two all-reduces with host-local and cross-host replica
+    groups respectively -- the two-level protocol a multislice job
+    routes over ICI then DCN.  An empty tuple is the identity fold.
     """
+    for ax in reversed(_value_axes(axis_name)):
+        state = _psum_merge_one(state, ax)
+    return state
+
+
+def _psum_merge_one(state: SketchState, axis_name: str) -> SketchState:
     return SketchState(
         bins_pos=lax.psum(state.bins_pos, axis_name),
         bins_neg=lax.psum(state.bins_neg, axis_name),
@@ -290,12 +654,13 @@ class DistributedDDSketch:
     def __init__(
         self,
         n_streams: int,
-        mesh: Optional[Mesh] = None,
-        value_axis: Optional[str] = "values",
+        mesh=None,
+        value_axis="values",
         stream_axis: Optional[str] = None,
         spec: Optional[SketchSpec] = None,
         engine: str = "auto",
         auto_recenter: Optional[bool] = None,
+        n_hosts: Optional[int] = None,
         **spec_kwargs,
     ):
         # Same auto-recenter default as BatchedDDSketch: center each
@@ -307,18 +672,56 @@ class DistributedDDSketch:
         if spec is None:
             spec = SketchSpec(**spec_kwargs)
         self.spec = spec
-        if mesh is None:
-            default_axis = value_axis or stream_axis
-            if default_axis is None:
+        # Mesh resolution: a rebuildable SketchMesh (the elastic path), a
+        # bare jax Mesh (honored as-is; reshard then needs an explicit
+        # target), or None -> a 1-D SketchMesh over every device on the
+        # first non-None axis (the historical default).
+        if isinstance(value_axis, (tuple, list)):
+            value_axis = tuple(value_axis) or None
+        self._sketch_mesh: Optional[SketchMesh] = None
+        if isinstance(mesh, SketchMesh):
+            self._sketch_mesh = mesh
+            if n_hosts is None:
+                n_hosts = mesh.n_hosts
+            mesh = mesh.build()
+        elif mesh is None:
+            if value_axis is None and stream_axis is None:
                 raise SpecError(
                     "Need at least one of value_axis / stream_axis (or pass"
                     " an explicit mesh)"
                 )
-            mesh = default_mesh((default_axis,))
+            if value_axis is not None:
+                self._sketch_mesh = SketchMesh(
+                    value_axis=value_axis, n_hosts=n_hosts
+                )
+            else:
+                self._sketch_mesh = SketchMesh(
+                    value_axis=None,
+                    stream_axis=stream_axis,
+                    stream_shards=len(jax.devices()),
+                )
+            if n_hosts is None:
+                n_hosts = self._sketch_mesh.n_hosts
+            mesh = self._sketch_mesh.build()
         self.mesh = mesh
         self.value_axis = value_axis
         self.stream_axis = stream_axis
-        self.n_value_shards = mesh.shape[value_axis] if value_axis else 1
+        vaxes = _value_axes(value_axis)
+        self.n_value_shards = (
+            int(np.prod([mesh.shape[a] for a in vaxes])) if vaxes else 1
+        )
+        # Host (ICI-group) bookkeeping: value shards group contiguously
+        # into n_hosts groups (the mesh.host_loss fault site's unit and
+        # the hierarchical fold's outer-axis size).
+        if n_hosts is None:
+            n_hosts = mesh.shape[vaxes[0]] if len(vaxes) == 2 else 1
+        n_hosts = max(int(n_hosts), 1)
+        if vaxes and self.n_value_shards % n_hosts:
+            raise SpecError(
+                f"{self.n_value_shards} value shards do not divide into"
+                f" {n_hosts} hosts"
+            )
+        self.n_hosts = n_hosts if vaxes else 1
         self.n_streams = n_streams
 
         # Engine selection mirrors BatchedDDSketch, but alignment is judged
@@ -375,7 +778,9 @@ class DistributedDDSketch:
 
         def fold(partials):
             st = jax.tree.map(lambda x: x[0], partials)
-            if value_axis:
+            if vaxes:
+                # Hierarchical when value_axis is an (outer, inner) pair:
+                # the inner (ICI) axis reduces first, then the outer (DCN).
                 st = psum_merge(st, value_axis)
             return st
 
@@ -420,16 +825,16 @@ class DistributedDDSketch:
         def local_recenter_ingest(or_empty, partials, values, weights, mask):
             st = jax.tree.map(lambda x: x[0], partials)
             offs = auto_offset(spec, st, values, weights)
-            if value_axis:
-                offs = lax.pmax(offs, value_axis)
+            if vaxes:
+                offs = _pmax_axes(offs, vaxes)
             m = mask  # armed drift-chasing streams (may hold mass)
             if or_empty:
                 # First-batch auto-center: streams with no GLOBAL binned
                 # mass also recenter, and ONLY by this criterion -- an
                 # armed mask OR-s in, never gets restricted (review r4).
                 binned = st.count - st.zero_count
-                if value_axis:
-                    binned = lax.psum(binned, value_axis)
+                if vaxes:
+                    binned = _psum_axes(binned, vaxes)
                 m = jnp.logical_or(m, binned <= 0)
             st = recenter(spec, st, jnp.where(m, offs, st.key_offset))
             st = local_add(st, values, weights)
@@ -492,7 +897,7 @@ class DistributedDDSketch:
             from sketches_tpu.batched import data_center_offsets
 
             st = jax.tree.map(lambda x: x[0], partials)
-            folded = psum_merge(st, value_axis) if value_axis else st
+            folded = psum_merge(st, value_axis) if vaxes else st
             target = data_center_offsets(spec, folded)
             st = recenter(spec, st, target)
             return jax.tree.map(lambda x: x[None], st)
@@ -722,6 +1127,213 @@ class DistributedDDSketch:
             )
         return survived, report
 
+    def _host_shards(self, host: int) -> range:
+        """The contiguous value-shard indices owned by ``host`` (the
+        ICI-group layout ``SketchMesh`` builds; empty for an
+        out-of-range host index)."""
+        per = self.n_value_shards // max(self.n_hosts, 1)
+        if not 0 <= host < self.n_hosts:
+            return range(0)
+        return range(host * per, (host + 1) * per)
+
+    def reshard(
+        self,
+        mesh=None,
+        n_devices: Optional[int] = None,
+        *,
+        live_mask=None,
+        engine: Optional[str] = None,
+        n_hosts: Optional[int] = None,
+    ):
+        """Elastic kill-and-regrow: fold the surviving partials and
+        rebuild the fleet on a DIFFERENT mesh ->
+        ``(new facade, ReshardReport)``.
+
+        The elastic primitive mergeability buys: every partial is itself
+        an exact sketch, so ANY surviving subset folds to the exact
+        sketch of its mass, and the fold loads onto any topology (state
+        is topology-free).  Dead capacity comes from three places, all
+        combined: an explicit ``live_mask`` (``[n_value_shards]`` bool),
+        the armed ``mesh.shard`` fault site (dead value shards), and the
+        armed ``mesh.host_loss`` site (a whole ICI group dies at once).
+        The target topology is ``mesh`` (a ``SketchMesh`` or bare
+        ``Mesh``) or ``n_devices`` resized through this fleet's
+        :class:`SketchMesh` layout policy.
+
+        Accounting is EXACT and itemized: the report carries per-stream
+        surviving and dropped mass, and -- with the integrity layer
+        armed -- the merge-additive fingerprints across the boundary
+        (the regrown fleet's folded fingerprint must equal the
+        survivors' shard-lane sum; violations raise/quarantine per the
+        armed mode).  Atomic: a torn reshard (the ``reshard.torn``
+        fault site) or any other failure raises and leaves THIS facade
+        fully intact; the new fleet only replaces it on success.
+
+        Raises ``SpecError`` when ``SKETCHES_TPU_ELASTIC=0`` or no
+        target topology was given; ``ShardLossError`` when nothing
+        survives; ``SketchValueError`` on a malformed ``live_mask``.
+        """
+        if not registry.enabled(registry.ELASTIC):
+            raise SpecError(
+                "elastic resharding disabled (SKETCHES_TPU_ELASTIC=0);"
+                " checkpoint and restore_distributed onto the new"
+                " topology instead"
+            )
+        if tracing._ACTIVE and tracing.current() is None:
+            # Control-plane op outside any request: root a trace of its
+            # own so the fold/regrow/verify chain (engine events, the
+            # injected tear, the final elastic.reshard record) resolves
+            # as one causal unit under ``tracing --explain``.
+            with tracing.use(tracing.new_trace()):
+                return self._reshard_inner(
+                    mesh, n_devices, live_mask, engine, n_hosts
+                )
+        return self._reshard_inner(mesh, n_devices, live_mask, engine, n_hosts)
+
+    def _reshard_inner(self, mesh, n_devices, live_mask, engine, n_hosts):
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        k = self.n_value_shards
+        live = np.ones((k,), bool)
+        if live_mask is not None:
+            lm = np.asarray(live_mask, bool).reshape(-1)
+            if lm.shape[0] != k:
+                raise SketchValueError(
+                    f"live_mask length {lm.shape[0]} != n_value_shards {k}"
+                )
+            live &= lm
+        hosts_down: tuple = ()
+        if faults._ACTIVE:
+            dead = faults.dead_shards(k)
+            if dead:
+                live[list(dead)] = False
+            hosts_down = faults.lost_hosts(self.n_hosts)
+            for h in hosts_down:
+                live[list(self._host_shards(h))] = False
+        if not live.any():
+            raise ShardLossError(
+                f"all {k} value shards marked dead; nothing to regrow from"
+            )
+        # Mass accounting BEFORE anything moves: per-stream counts of
+        # every partial (itemization), and -- armed -- the survivors'
+        # fingerprint lane (the cross-boundary proof's left-hand side).
+        part_counts = np.asarray(
+            jax.device_get(self.partials.count), np.float64
+        )  # [K, N]
+        dropped_count = part_counts[~live].sum(axis=0)
+        fp_pre = None
+        if integrity._ACTIVE:
+            fp_shards = integrity.fingerprint(self.spec, self.partials)
+            fp_pre = (fp_shards * live[:, None]).sum(axis=0)
+        folded = fold_live_partials(self.spec, self.partials, live)
+        surviving_count = np.asarray(
+            jax.device_get(folded.count), np.float64
+        )
+        if faults._ACTIVE:
+            # Torn-reshard seam: an injected tear here models dying
+            # between the survivor fold and the regrow -- the original
+            # fleet (self) must remain fully usable.
+            faults.inject(faults.RESHARD_TORN)
+        # Resolve the target topology through the rebuildable layout.
+        if mesh is None:
+            if n_devices is None:
+                raise SpecError(
+                    "reshard needs a target: mesh= (SketchMesh or Mesh)"
+                    " or n_devices="
+                )
+            base = self._sketch_mesh
+            if base is None:
+                base = SketchMesh(
+                    self.mesh.devices.size,
+                    value_axis=self.value_axis,
+                    stream_axis=self.stream_axis,
+                    stream_shards=(
+                        self.mesh.shape[self.stream_axis]
+                        if self.stream_axis else 1
+                    ),
+                    n_hosts=self.n_hosts,
+                )
+            mesh = base.resized(n_devices)
+        if n_hosts is None and isinstance(mesh, SketchMesh):
+            n_hosts = mesh.n_hosts
+        new = DistributedDDSketch.from_merged_state(
+            folded,
+            self.spec,
+            mesh=mesh,
+            value_axis=self.value_axis,
+            stream_axis=self.stream_axis,
+            engine=self._engine_arg if engine is None else engine,
+            n_hosts=n_hosts,
+        )
+        new_count = np.asarray(
+            jax.device_get(new.merged_state().count), np.float64
+        )
+        exact = bool(
+            np.array_equal(new_count, surviving_count, equal_nan=True)
+        )
+        fp_post = None
+        if integrity._ACTIVE:
+            fp_post = integrity.fingerprint(self.spec, new.merged_state())
+            # The boundary proof: raise/quarantine per the armed mode.
+            integrity.verify_reshard(
+                self.spec, fp_pre, new.merged_state(),
+                seam="elastic.reshard",
+            )
+        from_devices = int(self.mesh.devices.size)
+        to_devices = int(new.mesh.devices.size)
+        report = ReshardReport(
+            live=live,
+            from_devices=from_devices,
+            to_devices=to_devices,
+            surviving_count=surviving_count,
+            dropped_count=dropped_count,
+            exact=exact,
+            lost_hosts=tuple(int(h) for h in hosts_down),
+            fingerprint_pre=fp_pre,
+            fingerprint_post=fp_post,
+        )
+        resilience.bump("elastic.reshards")
+        if report.n_dead:
+            resilience.bump("mesh.dead_shards", report.n_dead)
+            resilience.record_downgrade(
+                f"{self._health_component}.mesh",
+                f"{k} value shards",
+                f"{int(live.sum())} value shards",
+                f"reshard {from_devices}->{to_devices} devices; dead"
+                f" shards {report.dead_shards}; dropped"
+                f" {report.total_dropped_fraction:.4f} of total mass",
+            )
+        if hosts_down:
+            resilience.bump("mesh.host_losses", len(hosts_down))
+        kind = (
+            "grow" if to_devices > from_devices
+            else "shrink" if to_devices < from_devices
+            else "rebuild"
+        )
+        if _t0 is not None:
+            telemetry.finish_span("elastic.reshard_s", _t0)
+            telemetry.counter_inc("elastic.reshards", kind=kind)
+            telemetry.gauge_set("elastic.mesh_devices", float(to_devices))
+            if report.n_dead:
+                telemetry.counter_inc(
+                    "elastic.dropped_mass", report.total_dropped
+                )
+            if hosts_down:
+                telemetry.counter_inc(
+                    "elastic.host_losses", float(len(hosts_down))
+                )
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "elastic.reshard",
+                direction=kind,
+                from_devices=from_devices,
+                to_devices=to_devices,
+                n_dead=report.n_dead,
+                lost_hosts=str(report.lost_hosts),
+                dropped=report.total_dropped,
+                exact=exact,
+            )
+        return new, report
+
     def _invalidate_plans(self) -> None:
         self._window_plan = None
         self._tile_plans = {}
@@ -730,15 +1342,23 @@ class DistributedDDSketch:
         """The dispatched query callable (engine ladder in ``__init__``)."""
         return self._query_choice(qs_tuple)[1]
 
-    def _query_choice(self, qs_tuple: tuple):
+    def _query_choice(
+        self, qs_tuple: tuple, extra_disabled: frozenset = frozenset()
+    ):
         """Per-shard query dispatch -> ``(tier, fn)`` (engine ladder --
-        see ``__init__``; ``tier`` names the resilience ladder rung)."""
+        see ``__init__``; ``tier`` names the resilience ladder rung).
+        ``extra_disabled`` adds caller-scoped tier exclusions on top of
+        the facade's persistent health ladder (the serving tier's
+        breaker/deadline seam -- ``BatchedDDSketch._query_choice``
+        parity), without mutating the facade's demotion state."""
         from sketches_tpu import kernels
 
         spec = self.spec
         interpret = self._interpret
         q_total = len(qs_tuple)
         disabled = self._query_disabled
+        if extra_disabled:
+            disabled = self._query_disabled | extra_disabled
         if self._pallas_query and "windowed" not in disabled:
             n_local = self._n_local_streams
             if self._window_plan is None:
@@ -870,8 +1490,17 @@ class DistributedDDSketch:
         """Dispatch down the engine ladder, degrading on failure (mirrors
         ``BatchedDDSketch._run_query``; queries fold but never mutate the
         partials, so a retry on the next tier is always sound)."""
+        return self._run_query_tiered(qs_tuple, qs_arr)[1]
+
+    def _run_query_tiered(
+        self, qs_tuple: tuple, qs_arr: jax.Array,
+        extra_disabled: frozenset = frozenset(),
+    ):
+        """:meth:`_run_query` that also reports the resolved tier ->
+        ``(tier, values)``; failures degrade identically (the floor
+        re-raises)."""
         while True:
-            tier, fn = self._query_choice(qs_tuple)
+            tier, fn = self._query_choice(qs_tuple, extra_disabled)
             try:
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_LOWERING, tier=tier)
@@ -889,7 +1518,7 @@ class DistributedDDSketch:
                     tracing.record_event(
                         "engine.query", tier=tier, component="distributed"
                     )
-                return out
+                return tier, out
             except Exception as e:
                 nxt = resilience.demote_query_tier(self._query_disabled, tier)
                 if nxt is None:
@@ -904,6 +1533,21 @@ class DistributedDDSketch:
     def get_quantile_values(self, qs: Sequence[float]) -> jax.Array:
         qs = [float(q) for q in qs]
         return self._run_query(tuple(qs), jnp.asarray(qs))
+
+    def get_quantile_values_resolved(
+        self, quantiles: Sequence[float], disabled_tiers: Sequence[str] = (),
+    ):
+        """Fused multi-quantile that also names the engine tier that
+        answered -> ``(tier, [n_streams, Q])`` --
+        ``BatchedDDSketch.get_quantile_values_resolved`` parity, so a
+        mesh-sharded fleet can sit behind the serving tier's breaker/
+        deadline seam.  ``disabled_tiers`` excludes ladder rungs for
+        THIS call only; failures degrade down the remaining rungs and
+        the floor re-raises."""
+        qs = [float(q) for q in quantiles]
+        return self._run_query_tiered(
+            tuple(qs), jnp.asarray(qs), frozenset(disabled_tiers)
+        )
 
     def merge(self, other: "DistributedDDSketch") -> "DistributedDDSketch":
         """Fold another distributed batch into this one.
@@ -1033,11 +1677,12 @@ class DistributedDDSketch:
         cls,
         state: SketchState,
         spec: SketchSpec,
-        mesh: Optional[Mesh] = None,
-        value_axis: Optional[str] = "values",
+        mesh=None,
+        value_axis="values",
         stream_axis: Optional[str] = None,
         engine: str = "auto",
         live_mask=None,
+        n_hosts: Optional[int] = None,
     ) -> "DistributedDDSketch":
         """Build a mesh-sharded facade holding a FOLDED batch (the inverse
         of ``merged_state`` -- checkpoint resume, ``to_batched`` undo).
@@ -1060,6 +1705,11 @@ class DistributedDDSketch:
         """
         import dataclasses
 
+        if live_mask is None and state.bins_pos.ndim == 3:
+            # A stacked partials pytree with no mask: every partial is
+            # live (the fold is then pure addition -- a partials
+            # checkpoint restored whole).
+            live_mask = np.ones((state.bins_pos.shape[0],), bool)
         if live_mask is not None:
             live = np.asarray(live_mask, bool).reshape(-1)
             if state.bins_pos.ndim != 3 or state.bins_pos.shape[0] != live.shape[0]:
@@ -1091,6 +1741,7 @@ class DistributedDDSketch:
             stream_axis=stream_axis,
             spec=spec,
             engine=engine,
+            n_hosts=n_hosts,
         )
 
         def load_slot0(partials, st):
@@ -1100,6 +1751,14 @@ class DistributedDDSketch:
             )
             return dataclasses.replace(new, key_offset=off)
 
+        # The loaded state may live on a DIFFERENT device set (an elastic
+        # reshard folds on the old mesh); place it onto the new mesh
+        # first so the load jit sees one consistent device set.
+        merged_sharding = jax.tree.map(
+            lambda ps: NamedSharding(dist.mesh, ps),
+            _merged_pspec(stream_axis),
+        )
+        state = jax.device_put(state, merged_sharding)
         loaded = jax.jit(load_slot0)(dist.partials, state)
         # Pin the canonical partial sharding explicitly: the donated
         # ingest jits were traced against it, and an implicitly-propagated
@@ -1127,6 +1786,15 @@ class DistributedDDSketch:
         )
 
     # -- accessors ---------------------------------------------------------
+    @property
+    def state(self) -> SketchState:
+        """The folded ``[n_streams, n_bins]`` batch --
+        ``BatchedDDSketch.state`` parity for READ paths (the serving
+        tier's fingerprint/fused-dispatch seam), cached between ingests.
+        Never assign through this; mutate via :attr:`partials` (whose
+        setter invalidates the fold cache and plans)."""
+        return self.merged_state()
+
     @property
     def partials(self) -> SketchState:
         return self._partials

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -62,6 +62,7 @@ __all__ = [
     "QuarantineRecord",
     "QuarantineReport",
     "ShardLossReport",
+    "ReshardReport",
     "DowngradeEvent",
     "record_downgrade",
     "bump",
@@ -416,3 +417,60 @@ class ShardLossReport:
             return None
         total = float(self.surviving_count.sum() + self.dropped_count.sum())
         return float(self.dropped_count.sum()) / max(total, 1.0)
+
+
+@dataclasses.dataclass
+class ReshardReport:
+    """Accounting for one elastic reshard (kill-and-regrow boundary).
+
+    The regrown fleet holds EXACTLY the surviving mass: per-stream
+    ``surviving_count`` must reappear bit-identically in the new fleet's
+    fold (``exact``), and the mass lost with dead shards/hosts is
+    itemized per stream in ``dropped_count`` -- nothing is lost
+    silently.  ``fingerprint_pre``/``fingerprint_post`` carry the
+    integrity layer's merge-additive content fingerprints across the
+    boundary when it is armed (``None`` disarmed -- an absent proof,
+    not a passed one); ``fingerprints_match`` is then the cross-boundary
+    verdict.  A reshard that raises (torn, all-dead) produces NO report
+    -- the original fleet is untouched.
+    """
+
+    live: np.ndarray  # [K] bool, over the OLD mesh's value shards
+    from_devices: int
+    to_devices: int
+    surviving_count: np.ndarray  # [N]
+    dropped_count: np.ndarray  # [N] mass lost with the dead shards
+    exact: bool  # new fold count == surviving_count, bit-identical
+    lost_hosts: Tuple[int, ...] = ()
+    fingerprint_pre: Optional[np.ndarray] = None  # [N], armed only
+    fingerprint_post: Optional[np.ndarray] = None  # [N], armed only
+
+    @property
+    def dead_shards(self) -> List[int]:
+        return [int(i) for i in np.nonzero(~self.live)[0]]
+
+    @property
+    def n_dead(self) -> int:
+        return int((~self.live).sum())
+
+    @property
+    def total_dropped(self) -> float:
+        return float(self.dropped_count.sum())
+
+    @property
+    def total_dropped_fraction(self) -> float:
+        total = float(self.surviving_count.sum() + self.dropped_count.sum())
+        return self.total_dropped / max(total, 1.0)
+
+    @property
+    def fingerprints_match(self) -> Optional[bool]:
+        """Cross-boundary fingerprint verdict (None when integrity was
+        disarmed and no fingerprints were computed)."""
+        if self.fingerprint_pre is None or self.fingerprint_post is None:
+            return None
+        return bool(
+            np.allclose(
+                self.fingerprint_post, self.fingerprint_pre,
+                rtol=1e-5, atol=1e-3,
+            )
+        )

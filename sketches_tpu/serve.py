@@ -304,23 +304,99 @@ class SketchServer:
 
     # -- tenancy ----------------------------------------------------------
 
-    def add_tenant(self, name: str, n_streams: int, **kwargs):
+    def add_tenant(
+        self, name: str, n_streams: int, *, mesh=None, value_axis=None,
+        stream_axis=None, **kwargs,
+    ):
         """Register tenant ``name`` with its own isolated facade (and
         therefore its own ``SketchSpec``) -> the facade.
 
         ``kwargs`` pass through to ``BatchedDDSketch`` (``spec=``,
-        ``relative_accuracy=``, ``n_bins=``, ...).  Re-registering an
+        ``relative_accuracy=``, ``n_bins=``, ...).  Passing any of
+        ``mesh``/``value_axis``/``stream_axis`` instead builds a
+        mesh-sharded ``DistributedDDSketch`` tenant -- the elastic
+        fleet behind the serving tier; its read path (fingerprints,
+        fused dispatch, the breaker/deadline tier exclusions) is
+        API-identical, and :meth:`reshard_tenant` can later resize its
+        mesh live without poisoning the cache.  Re-registering an
         existing name raises ``SpecError`` -- tenant state is never
         silently replaced.
         """
-        from sketches_tpu.batched import BatchedDDSketch
-
         with self._lock:
             if name in self._tenants:
                 raise SpecError(f"tenant {name!r} already registered")
-            facade = BatchedDDSketch(n_streams, **kwargs)
+            if mesh is not None or value_axis is not None \
+                    or stream_axis is not None:
+                from sketches_tpu.parallel import (
+                    DistributedDDSketch,
+                    SketchMesh,
+                )
+
+                if isinstance(mesh, SketchMesh):
+                    # The layout already names its axes; honor them
+                    # unless the caller overrode explicitly.
+                    if value_axis is None and stream_axis is None:
+                        value_axis = mesh.value_axis
+                        stream_axis = mesh.stream_axis
+                elif value_axis is None and stream_axis is None:
+                    value_axis = "values"
+                facade = DistributedDDSketch(
+                    n_streams, mesh=mesh, value_axis=value_axis,
+                    stream_axis=stream_axis, **kwargs,
+                )
+            else:
+                from sketches_tpu.batched import BatchedDDSketch
+
+                facade = BatchedDDSketch(n_streams, **kwargs)
             self._tenants[name] = _Tenant(name, facade)
             return facade
+
+    def reshard_tenant(
+        self, name: str, mesh=None, n_devices: Optional[int] = None,
+        *, live_mask=None,
+    ):
+        """Resize a distributed tenant's mesh LIVE -- the tenant
+        survives the reshard -> its ``ReshardReport``.
+
+        Wraps :meth:`DistributedDDSketch.reshard` under the serving
+        lock (no request observes a half-resharded tenant).  Because
+        content fingerprints are topology-free, a clean reshard (no
+        dead shards) leaves every cached ``(tenant, fingerprint, q)``
+        entry VALID -- the cache survives, no recompute storm; a
+        reshard that dropped mass (dead shards/hosts) changed content,
+        so the tenant's write version bumps and the stale fingerprint
+        is released (old entries then miss naturally).  Raises
+        ``SpecError`` for a batched (non-distributed) tenant, an
+        unknown tenant, or when ``SKETCHES_TPU_ELASTIC=0``; a failed
+        reshard (torn, all shards dead) raises and leaves the tenant
+        untouched on its old mesh.
+        """
+        from sketches_tpu.parallel import DistributedDDSketch
+
+        t = self._tenant(name)
+        with self._lock:
+            if not isinstance(t.facade, DistributedDDSketch):
+                raise SpecError(
+                    f"tenant {name!r} is not mesh-sharded; only"
+                    " DistributedDDSketch tenants reshard"
+                )
+            new_facade, report = t.facade.reshard(
+                mesh=mesh, n_devices=n_devices, live_mask=live_mask
+            )
+            t.facade = new_facade
+            if report.n_dead:
+                # Dead shards dropped mass: the content (and so the
+                # fingerprint) changed -- stale cache entries must miss.
+                t.version += 1
+                t.fp_cache = None
+            if tracing._ACTIVE:
+                tracing.record_event(
+                    "serve.reshard", tenant=name,
+                    from_devices=report.from_devices,
+                    to_devices=report.to_devices,
+                    n_dead=report.n_dead, exact=report.exact,
+                )
+            return report
 
     def tenant(self, name: str):
         """The named tenant's facade (raises ``SpecError`` if unknown)."""

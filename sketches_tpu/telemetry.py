@@ -257,6 +257,26 @@ _DECLARED = (
     Metric("accuracy.collapsed_mass_frac", "gauge", "sketches_tpu.accuracy",
            "Fraction of a watched stream's mass clamped into the window"
            " edge bins at the most recent audit (label: stream)."),
+    Metric("elastic.reshards", "counter", "sketches_tpu.parallel",
+           "Elastic reshard operations completed (grow, shrink, and"
+           " kill-and-regrow alike; label: kind)."),
+    Metric("elastic.reshard_s", "histogram", "sketches_tpu.parallel",
+           "Elastic reshard wall time: fold the survivors, rebuild the"
+           " mesh, verify the boundary."),
+    Metric("elastic.dropped_mass", "counter", "sketches_tpu.parallel",
+           "Total mass itemized as lost to dead shards/hosts across"
+           " elastic reshards (exact per-stream accounting rides the"
+           " ReshardReport)."),
+    Metric("elastic.mesh_devices", "gauge", "sketches_tpu.parallel",
+           "Device count of the most recently built elastic mesh."),
+    Metric("elastic.host_losses", "counter", "sketches_tpu.parallel",
+           "Whole-host (ICI-group) losses folded around during elastic"
+           " reshards."),
+    Metric("elastic.dcn_partitions", "counter", "sketches_tpu.parallel",
+           "DCN partitions detected at the cross-host fold (unreachable"
+           " process-local partials folded around, accounted)."),
+    Metric("elastic.dcn_fold_s", "histogram", "sketches_tpu.parallel",
+           "Cross-host (DCN) fold of process-local merged partials."),
     Metric("serve.requests", "counter", "sketches_tpu.serve",
            "Quantile requests submitted to the serving tier (admitted,"
            " cached, and shed alike)."),

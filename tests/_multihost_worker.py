@@ -1,7 +1,7 @@
-"""Worker process for the 2-process multi-host smoke test.
+"""Worker process for the 2-process multi-host smoke tests.
 
 Run as: python _multihost_worker.py <coordinator_port> <process_id> <n_procs>
-        [snapshot_dir]
+        [snapshot_dir] [mode]
 
 Each process exposes 4 virtual CPU devices; ``jax.distributed.initialize``
 joins them into one 8-device job, ``make_global_mesh`` lays the job-wide
@@ -15,6 +15,13 @@ records its ingest/query work plus a deterministic per-process set of
 ``snapshot_dir/snap<pid>.json`` — the per-shard artifacts the parent
 test folds with ``telemetry.merge_snapshots`` (the fleet-aggregation
 path a real multi-host job's per-host snapshots take).
+
+``mode="elastic"`` runs the HIERARCHICAL fold instead: the job-wide
+mesh carries ("dcn", "ici") axes (processes x local devices), the
+psum-merge chain folds ICI first then DCN, and each worker checkpoints
+its PROCESS-LOCAL merged partial to ``snapshot_dir/partial<pid>.npz`` —
+the per-host artifacts the parent folds with ``parallel.fold_hosts``
+and resumes onto a different mesh size (the elastic DCN protocol).
 """
 import os
 import sys
@@ -26,9 +33,78 @@ from _meshenv import cpu_mesh_env
 LOCAL_DEVICES = 4
 
 
+def elastic_main(pid: int, nproc: int, snapshot_dir: str) -> None:
+    """The hierarchical ICI/DCN fold drill (mode="elastic"): job-wide
+    ("dcn", "ici") mesh, chained psum fold, per-process partial
+    checkpoints for the parent's fold_hosts."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sketches_tpu import checkpoint
+    from sketches_tpu.batched import SketchSpec, add, init, quantile
+    from sketches_tpu.parallel import (
+        make_hierarchical_mesh,
+        psum_merge,
+        shard_map,
+    )
+
+    n_shards = nproc * LOCAL_DEVICES
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    n_streams, chunk = 4, 64
+    sm = make_hierarchical_mesh()  # hosts from process indices
+    assert sm.n_hosts == nproc, sm
+    mesh = sm.build()
+    assert dict(mesh.shape) == {"dcn": nproc, "ici": LOCAL_DEVICES}
+
+    all_vals = (
+        np.random.RandomState(1)
+        .normal(40.0, 4.0, (n_shards, n_streams, chunk))
+        .astype(np.float32)
+    )
+    sharding = NamedSharding(mesh, P(("dcn", "ici"), None, None))
+    local = all_vals[pid * LOCAL_DEVICES:(pid + 1) * LOCAL_DEVICES]
+    vals = jax.make_array_from_process_local_data(sharding, local)
+
+    def ingest_and_fold(vals_):
+        st = add(spec, init(spec, n_streams), vals_[0])
+        # ICI first (this host's shards), then the DCN boundary.
+        return psum_merge(st, ("dcn", "ici"))
+
+    folded = jax.jit(
+        shard_map(
+            ingest_and_fold,
+            mesh=mesh,
+            in_specs=(P(("dcn", "ici"), None, None),),
+            out_specs=jax.tree.map(lambda _: P(), init(spec, n_streams)),
+        )
+    )(vals)
+    assert np.asarray(folded.count).tolist() == [n_shards * chunk] * n_streams
+    got = np.asarray(
+        jax.jit(lambda st: quantile(spec, st, jnp.asarray([0.5])))(folded)
+    )
+    union = all_vals.transpose(1, 0, 2).reshape(n_streams, -1)
+    for i in range(n_streams):
+        exact = np.quantile(union[i], 0.5, method="lower")
+        assert abs(got[i, 0] - exact) <= 0.0101 * abs(exact) + 1e-6
+
+    # The per-host partial the elastic DCN protocol ships: this
+    # process's OWN shards, folded locally, checkpointed for the parent.
+    local_state = add(
+        spec,
+        init(spec, n_streams),
+        jnp.asarray(local.transpose(1, 0, 2).reshape(n_streams, -1)),
+    )
+    checkpoint.save_state(
+        os.path.join(snapshot_dir, f"partial{pid}.npz"), spec, local_state
+    )
+
+
 def main() -> None:
     port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     snapshot_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    mode = sys.argv[5] if len(sys.argv) > 5 else "base"
     os.environ.update(cpu_mesh_env(LOCAL_DEVICES, os.environ))
     import jax
 
@@ -51,6 +127,12 @@ def main() -> None:
     n_shards = nproc * LOCAL_DEVICES
     assert len(jax.devices()) == n_shards, jax.devices()
     assert len(jax.local_devices()) == LOCAL_DEVICES
+
+    if mode == "elastic":
+        elastic_main(pid, nproc, snapshot_dir)
+        jax.distributed.shutdown()
+        print(f"MULTIHOST_OK pid={pid}")
+        return
 
     import numpy as np
     import jax.numpy as jnp

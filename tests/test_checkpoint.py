@@ -121,6 +121,150 @@ def test_restore_distributed_roundtrip(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Elastic restores (r14): a checkpoint resumes onto a DIFFERENT mesh size
+# ---------------------------------------------------------------------------
+
+
+def _distributed_on(k, n_streams=8, seed=0):
+    from sketches_tpu.parallel import SketchMesh
+
+    d = DistributedDDSketch(
+        n_streams, mesh=SketchMesh(k), relative_accuracy=0.02, n_bins=256
+    )
+    d.add(
+        np.random.RandomState(seed)
+        .lognormal(0, 0.5, (n_streams, 64))
+        .astype(np.float32)
+    )
+    return d
+
+
+@pytest.mark.parametrize("k_save,k_restore", [(1, 2), (4, 2), (2, 1)])
+def test_restore_distributed_onto_different_mesh_size(
+    tmp_path, k_save, k_restore
+):
+    """The elastic resume: save on one mesh size, restore onto another --
+    the fold reproduces the saved totals exactly and the restored fleet
+    keeps ingesting on its new topology."""
+    from sketches_tpu import checkpoint
+    from sketches_tpu.parallel import SketchMesh
+
+    src = _distributed_on(k_save, seed=k_save)
+    path = str(tmp_path / "elastic.npz")
+    checkpoint.save(path, src)
+    back = checkpoint.restore_distributed(path, mesh=SketchMesh(k_restore))
+    assert back.n_value_shards == k_restore
+    ref, got = src.merged_state(), back.merged_state()
+    for f in ("bins_pos", "bins_neg", "count", "sum", "key_offset"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, f)), np.asarray(getattr(ref, f)), f
+        )
+    back.add(np.ones((8, 8 * k_restore), np.float32))
+    assert float(np.asarray(back.count)[0]) == 64.0 + 8 * k_restore
+
+
+def test_restore_distributed_armed_integrity_reverifies(tmp_path):
+    """An armed save embeds the fingerprint; an armed restore onto a
+    DIFFERENT mesh size re-verifies it (fingerprints are topology-free),
+    and a doctored archive refuses loudly."""
+    import zipfile
+
+    from sketches_tpu import checkpoint, integrity
+    from sketches_tpu.parallel import SketchMesh
+    from sketches_tpu.resilience import IntegrityError
+
+    integrity.arm("raise")
+    try:
+        src = _distributed_on(4, seed=7)
+        path = str(tmp_path / "armed.npz")
+        checkpoint.save(path, src)
+        back = checkpoint.restore_distributed(path, mesh=SketchMesh(2))
+        np.testing.assert_array_equal(
+            integrity.fingerprint(back.spec, back.merged_state()),
+            integrity.fingerprint(src.spec, src.merged_state()),
+        )
+        # Forge the stored fingerprint: the armed restore must refuse.
+        forged = str(tmp_path / "forged.npz")
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(forged, "w") as zout:
+            for item in zin.namelist():
+                data = zin.read(item)
+                if "fingerprint" in item:
+                    buf = np.lib.format.read_array(
+                        __import__("io").BytesIO(data)
+                    )
+                    out = __import__("io").BytesIO()
+                    np.lib.format.write_array(
+                        out, np.asarray(buf) + 1.0, allow_pickle=False
+                    )
+                    data = out.getvalue()
+                zout.writestr(item, data)
+        with pytest.raises((IntegrityError, CheckpointCorrupt)):
+            checkpoint.restore_distributed(forged, mesh=SketchMesh(2))
+    finally:
+        integrity.disarm()
+
+
+def test_partials_checkpoint_restores_with_live_mask(tmp_path):
+    """save(partials=True) keeps the shard axis; a live_mask restore
+    drops dead shards at restore time with exact accounting."""
+    import jax
+
+    from sketches_tpu import checkpoint
+    from sketches_tpu.parallel import SketchMesh
+
+    src = _distributed_on(4, seed=9)
+    part_counts = np.asarray(
+        jax.device_get(src.partials.count), np.float64
+    )
+    path = str(tmp_path / "partials.npz")
+    checkpoint.save(path, src, partials=True)
+    # Whole restore (no mask): every shard's mass survives.
+    whole = checkpoint.restore_distributed(path, mesh=SketchMesh(2))
+    np.testing.assert_array_equal(
+        np.asarray(whole.count, np.float64), part_counts.sum(axis=0)
+    )
+    # Masked restore: shard 3 dead, its mass dropped and accounted.
+    back = checkpoint.restore_distributed(
+        path, mesh=SketchMesh(2), live_mask=[True, True, True, False]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.count, np.float64), part_counts[:3].sum(axis=0)
+    )
+    # partials=True on a batched facade is a loud SpecError.
+    from sketches_tpu.resilience import SpecError
+
+    with pytest.raises(SpecError, match="partials"):
+        checkpoint.save(path, BatchedDDSketch(4, spec=src.spec),
+                        partials=True)
+
+
+def test_torn_reshard_checkpoint_raises_not_loses(tmp_path):
+    """A reshard interrupted mid-checkpoint can never silently lose
+    mass: the torn file raises CheckpointCorrupt, and the PREVIOUS
+    checkpoint (atomic writes) still restores the full fleet."""
+    from sketches_tpu import checkpoint
+    from sketches_tpu.parallel import SketchMesh
+
+    src = _distributed_on(2, seed=11)
+    path = str(tmp_path / "reshard.npz")
+    checkpoint.save(path, src, partials=True)  # the good previous file
+    with faults.active({faults.CHECKPOINT_WRITE: dict(mode="truncate")}):
+        checkpoint.save(path, src, partials=True)  # torn bytes land
+    with pytest.raises(CheckpointCorrupt):
+        checkpoint.restore_distributed(path, mesh=SketchMesh(4))
+    # Crash-before-rename variant: previous file survives intact.
+    checkpoint.save(path, src, partials=True)
+    with faults.active({faults.CHECKPOINT_WRITE: dict(mode="raise")}):
+        with pytest.raises(InjectedFault):
+            checkpoint.save(path, src, partials=True)
+    back = checkpoint.restore_distributed(path, mesh=SketchMesh(4))
+    np.testing.assert_array_equal(
+        np.asarray(back.count), np.asarray(src.count)
+    )
+
+
+# ---------------------------------------------------------------------------
 # Durability contract (r7): atomic writes, validated restores
 # ---------------------------------------------------------------------------
 

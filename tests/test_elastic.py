@@ -1,0 +1,446 @@
+"""Elastic-fleet tests: rebuildable meshes, hierarchical ICI/DCN folds,
+and live kill-and-regrow resharding with exact mass accounting (ROADMAP
+item 5; runs on the conftest's virtual 8-device CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sketches_tpu import chaos, faults, integrity, resilience, telemetry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, add, init, quantile
+from sketches_tpu.parallel import (
+    DistributedDDSketch,
+    SketchMesh,
+    fold_hosts,
+    make_hierarchical_mesh,
+    psum_merge,
+)
+from sketches_tpu.resilience import (
+    InjectedFault,
+    ShardLossError,
+    SpecError,
+)
+
+SPEC = SketchSpec(relative_accuracy=0.02, n_bins=256)
+QS = [0.25, 0.5, 0.9, 0.99]
+
+
+def _vals(n_streams, width, seed=0):
+    return (
+        np.random.RandomState(seed)
+        .lognormal(0.0, 0.5, (n_streams, width))
+        .astype(np.float32)
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_layers():
+    faults.disarm()
+    integrity.disarm()
+    yield
+    faults.disarm()
+    integrity.disarm()
+
+
+# ---------------------------------------------------------------------------
+# SketchMesh: the rebuildable layout
+# ---------------------------------------------------------------------------
+
+
+class TestSketchMesh:
+    def test_build_and_resize(self):
+        sm = SketchMesh(4, n_hosts=2)
+        assert sm.n_devices == 4 and sm.n_value_shards == 4
+        mesh = sm.build()
+        assert dict(mesh.shape) == {"values": 4}
+        grown = sm.resized(8)
+        assert grown.n_devices == 8 and grown.n_hosts == 2
+        shrunk = sm.resized(1)
+        assert shrunk.n_devices == 1
+        # 1 value shard cannot span 2 hosts: grouping collapses.
+        assert shrunk.n_hosts == 1
+
+    def test_hierarchical_build(self):
+        sm = make_hierarchical_mesh(n_hosts=2)
+        mesh = sm.build()
+        assert dict(mesh.shape) == {"dcn": 2, "ici": 4}
+
+    def test_invalid_layouts_raise(self):
+        with pytest.raises(SpecError, match="devices"):
+            SketchMesh(99)
+        with pytest.raises(SpecError, match="hosts"):
+            SketchMesh(4, n_hosts=3)
+        with pytest.raises(SpecError, match="stream"):
+            SketchMesh(8, value_axis=None, stream_axis=None)
+        with pytest.raises(SpecError, match="stream_axis"):
+            SketchMesh(8, stream_shards=2)
+        with pytest.raises(SpecError, match="pair"):
+            SketchMesh(8, value_axis=("a", "b", "c"))
+
+    def test_facade_accepts_sketch_mesh(self):
+        d = DistributedDDSketch(4, mesh=SketchMesh(4, n_hosts=2), spec=SPEC)
+        assert d.n_value_shards == 4 and d.n_hosts == 2
+        d.add(_vals(4, 64))
+        assert np.asarray(d.count).tolist() == [64.0] * 4
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical ICI/DCN fold
+# ---------------------------------------------------------------------------
+
+
+class TestHierarchicalFold:
+    def test_two_level_fold_matches_flat(self):
+        """A ("dcn", "ici") facade answers identically to the flat
+        single-axis facade and to an unsharded reference."""
+        vals = _vals(4, 128, seed=3)
+        hier = DistributedDDSketch(
+            4, mesh=make_hierarchical_mesh(n_hosts=2),
+            value_axis=("dcn", "ici"), spec=SPEC,
+        )
+        flat = DistributedDDSketch(4, spec=SPEC)
+        hier.add(vals)
+        flat.add(vals)
+        ref = add(SPEC, init(SPEC, 4), jnp.asarray(vals))
+        np.testing.assert_allclose(
+            np.asarray(hier.merged_state().bins_pos),
+            np.asarray(ref.bins_pos), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(hier.get_quantile_values(QS)),
+            np.asarray(flat.get_quantile_values(QS)), rtol=1e-5,
+        )
+
+    def test_hierarchical_psum_merge_inside_shard_map(self):
+        """psum_merge over an (outer, inner) tuple folds ICI first then
+        DCN and reproduces the full reduction."""
+        from sketches_tpu.parallel import shard_map
+
+        mesh = make_hierarchical_mesh(n_hosts=2).build()
+        vals = _vals(2, 8, seed=4)
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, ("dcn", "ici"))
+        )
+        v = jax.device_put(jnp.asarray(vals), sharding)
+
+        def body(v_):
+            st = add(SPEC, init(SPEC, 2), v_)
+            return psum_merge(st, ("dcn", "ici"))
+
+        folded = jax.jit(
+            shard_map(
+                body, mesh=mesh,
+                in_specs=(jax.sharding.PartitionSpec(None, ("dcn", "ici")),),
+                out_specs=jax.tree.map(
+                    lambda _: jax.sharding.PartitionSpec(),
+                    init(SPEC, 2),
+                ),
+            )
+        )(v)
+        assert np.asarray(folded.count).tolist() == [8.0, 8.0]
+        ref = add(SPEC, init(SPEC, 2), jnp.asarray(vals))
+        np.testing.assert_allclose(
+            np.asarray(quantile(SPEC, folded, jnp.asarray([0.5]))),
+            np.asarray(quantile(SPEC, ref, jnp.asarray([0.5]))),
+            rtol=1e-6,
+        )
+
+    def test_fold_hosts_equals_union(self):
+        """The DCN fold over process-local merged partials equals one
+        sketch of the union."""
+        va, vb = _vals(4, 64, seed=5), _vals(4, 64, seed=6)
+        a = BatchedDDSketch(4, spec=SPEC)
+        b = BatchedDDSketch(4, spec=SPEC)
+        a.add(va)
+        b.add(vb)
+        folded, report = fold_hosts(SPEC, [a.state, b.state])
+        assert report.n_dead == 0
+        ref = add(SPEC, init(SPEC, 4), jnp.asarray(np.concatenate([va, vb], 1)))
+        np.testing.assert_allclose(
+            np.asarray(folded.count), np.asarray(ref.count), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(quantile(SPEC, folded, jnp.asarray(QS))),
+            np.asarray(quantile(SPEC, ref, jnp.asarray(QS))),
+            rtol=1e-5,
+        )
+
+    def test_fold_hosts_aligns_disagreeing_windows(self):
+        """Hosts that auto-centered onto different windows still fold to
+        contract-true quantiles (alignment recenter, then add)."""
+        rng = np.random.RandomState(7)
+        va = (rng.lognormal(0, 0.2, (2, 128)) * 1e-3).astype(np.float32)
+        vb = (rng.lognormal(0, 0.2, (2, 128)) * 1e-3).astype(np.float32)
+        a = BatchedDDSketch(2, relative_accuracy=0.01, n_bins=512)
+        b = BatchedDDSketch(2, relative_accuracy=0.01, n_bins=512)
+        a.add(va)
+        b.add(vb)
+        spec = a.spec
+        folded, report = fold_hosts(spec, [a.state, b.state])
+        assert report.n_dead == 0
+        both = np.concatenate([va, vb], axis=1)
+        got = np.asarray(quantile(spec, folded, jnp.asarray([0.5, 0.99])))
+        for j, q in enumerate((0.5, 0.99)):
+            exact = np.quantile(both, q, axis=1, method="lower")
+            assert np.all(np.abs(got[:, j] - exact) <= 0.0101 * exact)
+
+    def test_fold_hosts_partition_detected_and_accounted(self):
+        a = BatchedDDSketch(4, spec=SPEC)
+        b = BatchedDDSketch(4, spec=SPEC)
+        a.add(_vals(4, 64, seed=8))
+        b.add(_vals(4, 32, seed=9))
+        before = resilience.health()["counters"].get("dcn.partitions", 0)
+        with faults.active({faults.DCN_PARTITION: dict(shards=(1,))}):
+            folded, report = fold_hosts(SPEC, [a.state, b.state])
+        assert report.dead_shards == [1]
+        np.testing.assert_array_equal(
+            np.asarray(folded.count), np.asarray(a.state.count)
+        )
+        np.testing.assert_array_equal(
+            report.dropped_count, np.asarray(b.state.count, np.float64)
+        )
+        assert resilience.health()["counters"]["dcn.partitions"] > before
+        # All hosts partitioned away: loud, never an empty answer.
+        with faults.active({faults.DCN_PARTITION: dict(shards=(0, 1))}):
+            with pytest.raises(ShardLossError):
+                fold_hosts(SPEC, [a.state, b.state])
+
+    def test_fold_hosts_validation(self):
+        from sketches_tpu.resilience import SketchValueError
+
+        with pytest.raises(SketchValueError, match="at least one"):
+            fold_hosts(SPEC, [])
+        a = BatchedDDSketch(4, spec=SPEC)
+        b = BatchedDDSketch(2, spec=SPEC)
+        with pytest.raises(SketchValueError, match="equal-shape"):
+            fold_hosts(SPEC, [a.state, b.state])
+
+
+# ---------------------------------------------------------------------------
+# Live resharding
+# ---------------------------------------------------------------------------
+
+
+class TestReshard:
+    @pytest.mark.parametrize("k_from,k_to", [(1, 2), (4, 2), (2, 1), (2, 8)])
+    def test_clean_grow_shrink_exact(self, k_from, k_to):
+        vals = _vals(8, 64, seed=10)
+        d = DistributedDDSketch(8, mesh=SketchMesh(k_from), spec=SPEC)
+        d.add(vals)
+        before = np.asarray(d.get_quantile_values(QS))
+        new, report = d.reshard(n_devices=k_to)
+        assert (report.from_devices, report.to_devices) == (k_from, k_to)
+        assert report.exact and report.n_dead == 0
+        assert report.total_dropped == 0.0
+        np.testing.assert_array_equal(
+            np.asarray(new.count), np.asarray(d.count)
+        )
+        np.testing.assert_allclose(
+            np.asarray(new.get_quantile_values(QS)), before, rtol=1e-6
+        )
+        # The regrown fleet keeps ingesting (width divisible by k_to).
+        new.add(_vals(8, 8 * max(k_to, 1), seed=11))
+        assert float(np.asarray(new.count)[0]) == 64.0 + 8 * max(k_to, 1)
+
+    def test_kill_and_regrow_itemizes_dropped_mass(self):
+        integrity.arm("raise")
+        d = DistributedDDSketch(8, mesh=SketchMesh(4, n_hosts=2), spec=SPEC)
+        d.add(_vals(8, 64, seed=12))
+        d.add(_vals(8, 64, seed=13))
+        part_counts = np.asarray(d.partials.count, np.float64)
+        with faults.active({faults.MESH_SHARD: dict(shards=(2,))}):
+            new, report = d.reshard(n_devices=8)
+        assert report.dead_shards == [2]
+        np.testing.assert_array_equal(report.dropped_count, part_counts[2])
+        np.testing.assert_array_equal(
+            report.surviving_count,
+            part_counts[[0, 1, 3]].sum(axis=0),
+        )
+        assert report.exact
+        assert report.fingerprints_match is True
+        np.testing.assert_array_equal(
+            np.asarray(new.count, np.float64), report.surviving_count
+        )
+
+    def test_host_loss_kills_whole_ici_group(self):
+        integrity.arm("raise")
+        d = DistributedDDSketch(8, mesh=SketchMesh(8, n_hosts=4), spec=SPEC)
+        d.add(_vals(8, 64, seed=14))
+        part_counts = np.asarray(d.partials.count, np.float64)
+        with faults.active({faults.MESH_HOST_LOSS: dict(shards=(1,))}):
+            new, report = d.reshard(n_devices=4)
+        assert report.lost_hosts == (1,)
+        assert report.dead_shards == [2, 3]  # host 1 owns shards 2..3
+        np.testing.assert_array_equal(
+            report.dropped_count, part_counts[[2, 3]].sum(axis=0)
+        )
+        assert report.exact and report.fingerprints_match is True
+        assert (
+            resilience.health()["counters"].get("mesh.host_losses", 0) >= 1
+        )
+
+    def test_torn_reshard_is_atomic(self):
+        d = DistributedDDSketch(4, mesh=SketchMesh(2), spec=SPEC)
+        d.add(_vals(4, 64, seed=15))
+        fp_before = integrity.fingerprint(SPEC, d.merged_state())
+        with faults.active({faults.RESHARD_TORN: dict(times=1)}):
+            with pytest.raises(InjectedFault):
+                d.reshard(n_devices=4)
+        # The original fleet is fully intact and still serving.
+        np.testing.assert_array_equal(
+            integrity.fingerprint(SPEC, d.merged_state()), fp_before
+        )
+        d.add(_vals(4, 64, seed=16))
+        assert float(np.asarray(d.count)[0]) == 128.0
+
+    def test_all_dead_raises(self):
+        d = DistributedDDSketch(4, mesh=SketchMesh(2), spec=SPEC)
+        d.add(_vals(4, 64, seed=17))
+        with pytest.raises(ShardLossError):
+            d.reshard(n_devices=4, live_mask=[False, False])
+
+    def test_reshard_needs_a_target(self):
+        d = DistributedDDSketch(4, mesh=SketchMesh(2), spec=SPEC)
+        with pytest.raises(SpecError, match="target"):
+            d.reshard()
+
+    def test_kill_switch_refuses(self, monkeypatch):
+        from sketches_tpu.analysis import registry
+
+        monkeypatch.setenv(registry.ELASTIC.name, "0")
+        d = DistributedDDSketch(4, mesh=SketchMesh(2), spec=SPEC)
+        d.add(_vals(4, 64, seed=18))
+        with pytest.raises(SpecError, match="ELASTIC"):
+            d.reshard(n_devices=4)
+        # The fleet itself is untouched by the refusal.
+        assert float(np.asarray(d.count)[0]) == 64.0
+
+    def test_hierarchical_fleet_reshards(self):
+        d = DistributedDDSketch(
+            4, mesh=make_hierarchical_mesh(n_hosts=2),
+            value_axis=("dcn", "ici"), spec=SPEC,
+        )
+        d.add(_vals(4, 64, seed=19))
+        new, report = d.reshard(n_devices=4)
+        assert report.exact
+        assert new.n_value_shards == 4 and new.n_hosts == 2
+        np.testing.assert_array_equal(
+            np.asarray(new.count), np.asarray(d.count)
+        )
+
+    def test_reshard_telemetry_and_events(self):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            d = DistributedDDSketch(4, mesh=SketchMesh(4), spec=SPEC)
+            d.add(_vals(4, 64, seed=20))
+            with faults.active({faults.MESH_SHARD: dict(shards=(0,))}):
+                d.reshard(n_devices=2)
+            snap = telemetry.snapshot()
+            assert snap["counters"]['elastic.reshards{kind="shrink"}'] == 1
+            assert snap["counters"]["elastic.dropped_mass"] > 0
+            assert snap["gauges"]["elastic.mesh_devices"] == 2.0
+            assert any(
+                k.startswith("elastic.reshard_s")
+                for k in snap["histograms"]
+            )
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Serving-tier survival
+# ---------------------------------------------------------------------------
+
+
+class TestServeReshard:
+    def _server(self):
+        from sketches_tpu import serve
+
+        srv = serve.SketchServer()
+        srv.add_tenant("fleet", 8, mesh=SketchMesh(4), spec=SPEC)
+        srv.ingest("fleet", _vals(8, 64, seed=21))
+        return srv
+
+    def test_distributed_tenant_serves(self):
+        srv = self._server()
+        direct = np.asarray(
+            srv.tenant("fleet").get_quantile_values([0.5, 0.99])
+        )
+        result = srv.query("fleet", (0.5, 0.99))
+        np.testing.assert_array_equal(result.values, direct)
+
+    def test_tenant_survives_clean_reshard_cache_intact(self):
+        srv = self._server()
+        r1 = srv.query("fleet", (0.5, 0.99))
+        report = srv.reshard_tenant("fleet", n_devices=2)
+        assert report.exact and report.n_dead == 0
+        # Fingerprints are topology-free: the cached entry is still
+        # valid and HITS (no recompute storm after a clean reshard).
+        r2 = srv.query("fleet", (0.5, 0.99))
+        assert r2.cached
+        np.testing.assert_array_equal(r2.values, r1.values)
+        # And the resharded tenant keeps serving writes.
+        srv.ingest("fleet", _vals(8, 64, seed=22))
+        r3 = srv.query("fleet", (0.5, 0.99))
+        assert not np.array_equal(r3.values, r1.values) or not r3.cached
+
+    def test_tenant_reshard_with_dead_shard_invalidates(self):
+        srv = self._server()
+        srv.query("fleet", (0.5,))
+        with faults.active({faults.MESH_SHARD: dict(shards=(1,))}):
+            report = srv.reshard_tenant("fleet", n_devices=4)
+        assert report.n_dead == 1
+        # Content changed: the old entry must MISS, and the recomputed
+        # answer must match a direct query of the surviving mass.
+        result = srv.query("fleet", (0.5,))
+        assert not result.cached
+        direct = np.asarray(srv.tenant("fleet").get_quantile_values([0.5]))
+        np.testing.assert_array_equal(result.values, direct)
+
+    def test_batched_tenant_refuses_reshard(self):
+        from sketches_tpu import serve
+
+        srv = serve.SketchServer()
+        srv.add_tenant("plain", 4, spec=SPEC)
+        with pytest.raises(SpecError, match="mesh-sharded"):
+            srv.reshard_tenant("plain", n_devices=2)
+
+
+# ---------------------------------------------------------------------------
+# Elastic chaos campaign
+# ---------------------------------------------------------------------------
+
+
+class TestElasticCampaign:
+    def test_campaign_verdict_and_determinism(self):
+        verdict = chaos.run_elastic_campaign(60, seed=3)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["n_faults"] > 0
+        assert verdict["outcomes"].get("undetected", 0) == 0
+        assert verdict["reshards"] > 0
+        assert len(verdict["mesh_sizes_visited"]) >= 2
+        again = chaos.run_elastic_campaign(60, seed=3)
+        assert again["events"] == verdict["events"]
+
+    def test_campaign_cli_exit_code(self, tmp_path):
+        out = str(tmp_path / "verdict.json")
+        rc = chaos.main(
+            ["--campaign", "elastic", "--steps", "30", "--seed", "5",
+             "--out", out, "--platform", ""]
+        )
+        assert rc == 0
+        import json
+
+        with open(out, encoding="utf-8") as f:
+            verdict = json.load(f)
+        assert verdict["campaign"] == "elastic" and verdict["ok"]
+
+    def test_campaign_rejects_bad_steps(self):
+        from sketches_tpu.resilience import SketchValueError
+
+        with pytest.raises(SketchValueError):
+            chaos.run_elastic_campaign(0, seed=1)

@@ -39,5 +39,9 @@ def test_example_runs_clean(script):
     assert out.returncode == 0, out.stdout + "\n" + out.stderr
     if script == "distributed_mesh.py":
         # The example must actually demonstrate a multi-device mesh: its
-        # self-provisioning forces the 8-device virtual CPU platform.
-        assert "mesh: 8 x cpu" in out.stdout, out.stdout
+        # self-provisioning forces the 8-device virtual CPU platform --
+        # and the elastic drill must complete the kill/regrow/shrink
+        # cycle with exact accounting, not just start.
+        assert "devices: 8 x cpu" in out.stdout, out.stdout
+        assert "kill-and-regrow: 4 -> 8 devices" in out.stdout, out.stdout
+        assert "elastic drill passed" in out.stdout, out.stdout

@@ -542,6 +542,83 @@ def _detect_state_bitflip():
     return True
 
 
+def _detect_mesh_host_loss():
+    """A lost host (a whole ICI group of value shards) is folded around
+    at the reshard with its mass itemized exactly and the loss counted
+    in the health ledger."""
+    from sketches_tpu.parallel import SketchMesh
+
+    d = DistributedDDSketch(8, mesh=SketchMesh(4, n_hosts=2), spec=SPEC)
+    d.add(np.full((8, 16), 1.5, np.float32))
+    import jax
+
+    part_counts = np.asarray(
+        jax.device_get(d.partials.count), np.float64
+    )
+    faults.arm(faults.MESH_HOST_LOSS, shards=(1,))
+    try:
+        new, report = d.reshard(n_devices=2)
+    finally:
+        faults.disarm()
+    return (
+        report.lost_hosts == (1,)
+        and report.dead_shards == [2, 3]
+        and report.exact
+        and np.array_equal(
+            report.dropped_count, part_counts[[2, 3]].sum(axis=0)
+        )
+        and resilience.health()["counters"].get("mesh.host_losses", 0) >= 1
+    )
+
+
+def _detect_dcn_partition():
+    """A DCN partition at the cross-host fold is detected: the
+    unreachable host's partial is folded around with its mass accounted
+    (never silently zeroed) and the partition counted."""
+    from sketches_tpu.parallel import fold_hosts
+
+    a = _batched(seed=26)
+    b = _batched(seed=27)
+    before = resilience.health()["counters"].get("dcn.partitions", 0)
+    faults.arm(faults.DCN_PARTITION, shards=(1,))
+    try:
+        folded, report = fold_hosts(SPEC, [a.state, b.state])
+    finally:
+        faults.disarm()
+    return (
+        report.dead_shards == [1]
+        and np.array_equal(
+            np.asarray(folded.count), np.asarray(a.state.count)
+        )
+        and np.array_equal(
+            report.dropped_count, np.asarray(b.state.count, np.float64)
+        )
+        and resilience.health()["counters"].get("dcn.partitions", 0) > before
+    )
+
+
+def _detect_reshard_torn():
+    """A torn reshard raises (InjectedFault at the seam) and the
+    ORIGINAL fleet survives bit-identically -- reshard is atomic, so a
+    tear can never silently lose mass."""
+    from sketches_tpu.parallel import SketchMesh
+
+    d = DistributedDDSketch(8, mesh=SketchMesh(2), spec=SPEC)
+    d.add(np.full((8, 16), 2.5, np.float32))
+    fp_before = integrity.fingerprint(SPEC, d.merged_state())
+    faults.arm(faults.RESHARD_TORN, times=1)
+    try:
+        d.reshard(n_devices=4)
+        return False  # the tear did not surface
+    except resilience.InjectedFault:
+        pass
+    finally:
+        faults.disarm()
+    return np.array_equal(
+        integrity.fingerprint(SPEC, d.merged_state()), fp_before
+    ) and np.asarray(d.count).tolist() == [16.0] * 8
+
+
 def _serve_server():
     from sketches_tpu import serve
 
@@ -616,6 +693,9 @@ _SITE_DETECTORS = {
     faults.WIRE_BLOB: _detect_wire_blob,
     faults.CHECKPOINT_WRITE: _detect_checkpoint_write,
     faults.MESH_SHARD: _detect_mesh_shard,
+    faults.MESH_HOST_LOSS: _detect_mesh_host_loss,
+    faults.DCN_PARTITION: _detect_dcn_partition,
+    faults.RESHARD_TORN: _detect_reshard_torn,
     faults.STATE_BITFLIP: _detect_state_bitflip,
     faults.SERVE_STRAGGLER: _detect_serve_straggler,
     faults.SERVE_QUEUE_OVERFLOW: _detect_serve_queue_overflow,

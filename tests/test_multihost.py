@@ -48,8 +48,18 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_global_mesh_psum_merge(tmp_path):
+def _run_workers(tmp_path, mode=None, n_procs=2):
+    """Launch the worker pair and apply the CAPABILITY PROBE -> the
+    per-worker outputs (only on full success).
+
+    One probe for every multi-host case (the base psum-merge smoke and
+    the elastic hierarchical fold alike): environmental inability --
+    no sockets, no distributed runtime, a backend without multiprocess
+    collectives, a sandboxed handshake timeout -- SKIPS with the full
+    transcript; a worker assertion failure FAILS.  Keeping the probe in
+    one place is what keeps the slow lane clean on CPU-only jaxlib
+    while real worker failures still fail.
+    """
     try:
         port = _free_port()
     except OSError as e:  # pragma: no cover - sandboxed loopback
@@ -64,16 +74,17 @@ def test_two_process_global_mesh_psum_merge(tmp_path):
     # Workers provision their own platform/device count; scrub this
     # process's pytest-conftest values so they don't leak through.
     env.pop("XLA_FLAGS", None)
+    argv_tail = [str(tmp_path)] + ([mode] if mode else [])
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(port), str(pid), "2",
-             str(tmp_path)],
+            [sys.executable, _WORKER, str(port), str(pid), str(n_procs),
+             *argv_tail],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(n_procs)
     ]
     outs = []
     deadline = time.monotonic() + _TIMEOUT_S
@@ -113,7 +124,15 @@ def test_two_process_global_mesh_psum_merge(tmp_path):
             " tree):\n" + transcript
         )
     assert all(p.returncode == 0 for p in procs), transcript
-    assert all(f"MULTIHOST_OK pid={i}" in outs[i] for i in range(2)), transcript
+    assert all(
+        f"MULTIHOST_OK pid={i}" in outs[i] for i in range(n_procs)
+    ), transcript
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_global_mesh_psum_merge(tmp_path):
+    _run_workers(tmp_path)
 
     # Fleet aggregation: fold the two workers' telemetry snapshot files
     # -- the multi-host shard -> merged-artifact path.  Counters must
@@ -147,3 +166,62 @@ def test_two_process_global_mesh_psum_merge(tmp_path):
         assert abs(summary[label] - want) <= 2 * alpha * abs(want) + 1e-9, (
             label, summary[label], want,
         )
+
+
+@pytest.mark.slow
+def test_two_process_hierarchical_fold_and_elastic_resume(tmp_path):
+    """The elastic DCN protocol across a REAL process boundary: workers
+    run the hierarchical ("dcn", "ici") fold (ICI psum first, then the
+    DCN all-reduce) and checkpoint their process-local merged partials;
+    the parent folds those per-host partials with ``fold_hosts`` (the
+    serialize-and-ship variant of the same outer fold) and resumes one
+    onto a different mesh size.  Environmental inability skips via the
+    shared capability probe (same transcript discipline as the base
+    smoke); worker assertion failures fail."""
+    _run_workers(tmp_path, mode="elastic")
+
+    import numpy as np
+
+    from sketches_tpu import checkpoint
+    from sketches_tpu.parallel import SketchMesh, fold_hosts
+
+    states, spec = [], None
+    for pid in range(2):
+        spec, state = checkpoint.restore_state(
+            str(tmp_path / f"partial{pid}.npz")
+        )
+        states.append(state)
+    n_shards, n_streams, chunk = 8, 4, 64
+    folded, report = fold_hosts(spec, states)
+    assert report.n_dead == 0
+    assert np.asarray(folded.count).tolist() == \
+        [n_shards * chunk] * n_streams
+    # The union fold agrees with the dataset the workers ingested.
+    union = (
+        np.random.RandomState(1)
+        .normal(40.0, 4.0, (n_shards, n_streams, chunk))
+        .astype(np.float32)
+        .transpose(1, 0, 2)
+        .reshape(n_streams, -1)
+    )
+    import jax.numpy as jnp
+
+    from sketches_tpu.batched import quantile
+
+    got = np.asarray(quantile(spec, folded, jnp.asarray([0.5, 0.99])))
+    for i in range(n_streams):
+        for j, q in enumerate((0.5, 0.99)):
+            exact = np.quantile(union[i], q, method="lower")
+            assert abs(got[i, j] - exact) <= 0.0101 * abs(exact) + 1e-6
+    # Elastic resume: one host's partial regrows onto a 2-device mesh
+    # in THIS process (topology-free state), and keeps ingesting.
+    from sketches_tpu.parallel import DistributedDDSketch
+
+    back = DistributedDDSketch.from_merged_state(
+        states[0], spec, mesh=SketchMesh(2)
+    )
+    assert np.asarray(back.count).tolist() == \
+        [4 * chunk] * n_streams
+    back.add(np.ones((n_streams, 16), np.float32))
+    assert np.asarray(back.count).tolist() == \
+        [4 * chunk + 16] * n_streams
