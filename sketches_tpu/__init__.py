@@ -64,8 +64,9 @@ from sketches_tpu.store import (
 )
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
+from sketches_tpu import backends
 
-__version__ = "0.13.0"
+__version__ = "0.14.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -104,6 +105,9 @@ __all__ = [
     # Request tracing + flight recorder (trace contexts, exemplars,
     # forensic bundles)
     "tracing",
+    # Adaptive-accuracy backends (UDDSketch uniform collapse, compact
+    # moment summaries) behind the Store/KeyMapping seam
+    "backends",
     "ServeOverload",
     "DeadlineExceeded",
     "IntegrityError",

@@ -420,6 +420,12 @@ def _audit(w: _Watch) -> int:
     if sk_q is None:
         return 0
     collapsed = _facade_collapsed(facade)
+    spec = getattr(facade, "spec", None)
+    # Streams whose backend CAN collapse handle threshold crossings
+    # themselves (the uniform-collapse trigger); for everything else the
+    # crossing becomes a declared counter instead of dying in the gauge.
+    _recommendable = getattr(spec, "backend", "dense") != "uniform_collapse"
+    _collapse_thr = float(getattr(spec, "collapse_threshold", 0.01))
     w.audits += 1
     violations = 0
     worst_rel_err: Dict[int, float] = {}
@@ -461,6 +467,13 @@ def _audit(w: _Watch) -> int:
                         audit_index=w.audits, wall_time=now,
                     ))
         prev = w.last_collapsed.get(s, 0.0)
+        if _recommendable and prev <= _collapse_thr < frac:
+            # Edge-clamped mass crossed the threshold on a stream that
+            # cannot collapse: recommend the adaptive backend (counted
+            # once per crossing, not per audit -- prev gates re-fires).
+            telemetry.counter_inc(
+                "accuracy.collapse_recommended", stream=s
+            )
         if frac - prev > COLLAPSE_DRIFT:
             new_reports.append(DriftReport(
                 name=w.name, stream=s, kind="collapse-drift",

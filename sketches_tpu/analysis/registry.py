@@ -38,6 +38,7 @@ __all__ = [
     "SERVE_HEDGE",
     "ELASTIC",
     "FLIGHT_RECORDER",
+    "ADAPTIVE",
     "REGISTRY",
     "declared",
     "get",
@@ -197,6 +198,19 @@ SERVE_HEDGE = EnvVar(
     ),
 )
 
+#: Adaptive-accuracy backend kill switch (``sketches_tpu.backends``).
+ADAPTIVE = EnvVar(
+    name="SKETCHES_TPU_ADAPTIVE",
+    default="1",
+    owner="sketches_tpu.backends",
+    doc=(
+        "Set to 0 to refuse adaptive-accuracy collapses: a"
+        " uniform-collapse trigger (or explicit collapse call) raises"
+        " SpecError instead of degrading alpha; dense and moment"
+        " backends are unaffected."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
@@ -205,7 +219,7 @@ REGISTRY: Dict[str, EnvVar] = {
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
         ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
-        FLIGHT_RECORDER,
+        FLIGHT_RECORDER, ADAPTIVE,
     )
 }
 

@@ -1,0 +1,98 @@
+"""Adaptive-accuracy device backends behind the Store/KeyMapping seam.
+
+Every tenant used to pay one accuracy/memory contract: a dense
+``[n_streams, n_bins]`` bin store at a fixed alpha, with out-of-window
+mass silently clamped into the edge bins (counted by
+``collapsed_low/high``, but resolution, once lost, was lost).  This
+package opens the frontier to three contracts, selected per
+``SketchSpec.backend``:
+
+* ``"dense"`` -- the classic store (``sketches_tpu.batched``); nothing
+  here changes it.
+* ``"uniform_collapse"`` -- UDDSketch-style graceful degradation
+  (arXiv:2004.08604): when a stream's edge-clamped mass fraction
+  crosses ``spec.collapse_threshold``, adjacent bin pairs merge
+  uniformly (gamma -> gamma**2), halving resolution everywhere instead
+  of corrupting the tails; the per-stream collapse level rides in
+  :class:`~sketches_tpu.backends.uniform.AdaptiveState` and the
+  realized guarantee is ``effective_alpha``.  See
+  :mod:`sketches_tpu.backends.uniform`.
+* ``"moment"`` -- a compact moment summary (arXiv:1803.01969):
+  ~``2 * n_moments + 6`` f32 scalars per stream (~100 bytes at the
+  default k=12, vs ~4 KiB for 512 f32 bins), batched ingest fused into
+  one device dispatch, quantiles estimated on the host by a
+  maximum-entropy solve.  See :mod:`sketches_tpu.backends.moment`.
+
+Failure modes: :func:`facade_for` raises ``SpecError`` for an unknown
+backend name; the uniform-collapse trigger raises ``SpecError`` when
+``SKETCHES_TPU_ADAPTIVE=0`` (the kill switch -- collapse refuses
+loudly rather than degrading alpha behind an operator's back); moment
+quantiles fall back down a documented solver ladder and answer NaN
+only for empty streams.
+"""
+
+from __future__ import annotations
+
+from sketches_tpu.resilience import SpecError
+
+__all__ = [
+    "BACKEND_DENSE",
+    "BACKEND_UNIFORM_COLLAPSE",
+    "BACKEND_MOMENT",
+    "BACKEND_ENUM",
+    "BACKEND_NAMES",
+    "facade_for",
+]
+
+#: Wire-enum values (``SketchPayload.backend``; see
+#: ``sketches_tpu.backends.wirefmt``).  Append-only: decoders refuse
+#: unknown values loudly, so reusing a retired number would silently
+#: misdecode old blobs.
+BACKEND_DENSE = 0
+BACKEND_UNIFORM_COLLAPSE = 1
+BACKEND_MOMENT = 2
+
+#: backend name -> wire enum value (the ONE place the mapping lives).
+BACKEND_ENUM = {
+    "dense": BACKEND_DENSE,
+    "uniform_collapse": BACKEND_UNIFORM_COLLAPSE,
+    "moment": BACKEND_MOMENT,
+}
+
+#: wire enum value -> backend name.
+BACKEND_NAMES = {v: k for k, v in BACKEND_ENUM.items()}
+
+
+def facade_for(n_streams: int, **kwargs):
+    """Construct the facade matching ``kwargs``' spec/backend.
+
+    The single constructor seam the serving tier (and any other
+    spec-driven caller) uses: ``spec.backend`` picks the class --
+    ``BatchedDDSketch`` (dense), ``AdaptiveDDSketch``
+    (uniform_collapse), or ``MomentDDSketch`` (moment).  A ``backend=``
+    keyword is also accepted in place of a full spec.  Raises
+    ``SpecError`` for an unknown backend name (via ``SketchSpec``
+    validation); all other kwargs pass through to the facade.
+    """
+    spec = kwargs.get("spec")
+    backend = kwargs.pop("backend", None)
+    if backend is None:
+        backend = getattr(spec, "backend", "dense")
+    elif spec is not None and spec.backend != backend:
+        raise SpecError(
+            f"backend={backend!r} contradicts spec.backend="
+            f"{spec.backend!r}"
+        )
+    if backend == "uniform_collapse":
+        from sketches_tpu.backends.uniform import AdaptiveDDSketch
+
+        return AdaptiveDDSketch(n_streams, **kwargs)
+    if backend == "moment":
+        from sketches_tpu.backends.moment import MomentDDSketch
+
+        return MomentDDSketch(n_streams, **kwargs)
+    if backend != "dense":
+        raise SpecError(f"Unknown backend {backend!r}")
+    from sketches_tpu.batched import BatchedDDSketch
+
+    return BatchedDDSketch(n_streams, **kwargs)

@@ -1,0 +1,684 @@
+"""Compact moment-summary backend: ~100 bytes/stream, maxent quantiles.
+
+The moments sketch (arXiv:1803.01969) shows that for high-cardinality
+aggregation a quantile summary need not store bins at all: ``k`` power
+sums plus min/max/count support quantile estimation via a
+maximum-entropy density solve, merge by pure addition, and cost ~100
+bytes per stream -- two orders of magnitude under the dense store's
+``n_bins * 4`` bytes.  This module is that contract behind the same
+seams:
+
+* **State** (:class:`MomentState`): per stream ``count``,
+  ``zero_count``, ``neg_count``, ``sum``, ``min``, ``max`` plus ``k``
+  raw power sums of the nonzero values AND ``k`` power sums of
+  ``ln |v|`` (the paper's log-moments variant -- the accurate basis for
+  the long-tailed distributions sketches exist for).  All f32 on
+  device: ``(6 + 2k) * 4`` bytes/stream = 104 bytes at the default
+  ``k = 12``.
+* **Ingest** (:func:`add`) is ONE fused device dispatch: masks route
+  zeros/NaN/padding exactly like the dense tier, and the power sums
+  build by ``k`` fused multiply-accumulates over the batch.
+* **Merge** is elementwise addition (+ min/min, max/max), so
+  :func:`merge`, :func:`merge_axis`, :func:`psum_merge`, and
+  :func:`fold_hosts` are trivial and bit-exact across topologies.
+* **Query** (:func:`quantile`) runs on the HOST: standardized moments
+  (f64, binomial shift to [-1, 1]) -> Chebyshev moments -> Newton
+  solve of the maxent dual on a fixed grid -> CDF inversion, with a
+  documented fallback ladder (fewer moments -> uniform density) when
+  the solve cannot converge.  Zeros re-enter as a point mass at 0.
+
+Error envelope (documented, test-pinned on the ``tests/datasets.py``
+distributions): uniform / lognormal / pareto streams answer p5..p99
+within a few percent relative error at ``k = 12`` -- far looser than
+the dense alpha contract, which is exactly the trade the ~100x memory
+saving buys.  The raw-power basis (used when a stream holds
+non-positive values) loses fidelity when ``max - min`` spans more than
+~3 decades (f32 power sums saturate); the log basis (all-positive
+streams) has no such limit.
+
+Failure modes: empty streams answer NaN; a failed maxent solve falls
+back down the moment ladder (counted via
+``backend.moment_fallbacks``), never raises; merging unequal specs
+raises ``UnequalSketchParametersError``; fractional-weight and
+mixed-sign contracts are as documented above; f32 counters share the
+dense tier's 2**24 exact-accumulation ceiling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketches_tpu import telemetry
+from sketches_tpu.batched import DEFAULT_REL_ACC, SketchSpec
+from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
+from sketches_tpu.resilience import SpecError
+
+__all__ = [
+    "MomentState",
+    "MomentDDSketch",
+    "init",
+    "add",
+    "merge",
+    "merge_axis",
+    "psum_merge",
+    "fold_hosts",
+    "quantile",
+    "bytes_per_stream",
+]
+
+#: CDF grid resolution of the maxent solve (the paper uses a fixed
+#: Chebyshev grid too; 512 points bounds the inversion error at ~0.2%
+#: of the support per step, far under the moment-truncation error).
+_GRID = 512
+
+_MAX_NEWTON = 60
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MomentState:
+    """Per-batch moment-summary state (struct-of-arrays, all f32).
+
+    ``powers[:, i]`` is the weighted sum of ``v**(i+1)`` over nonzero
+    finite values (either sign); ``log_powers[:, i]`` the weighted sum
+    of ``ln|v| ** (i+1)`` over the same lanes.  ``min``/``max`` are
+    +/-inf for empty streams (the dense tier's convention); NaN values
+    poison ``sum`` and count into the zero bucket exactly like
+    :func:`sketches_tpu.batched.add`.
+    """
+
+    count: jax.Array  # [n_streams] total weight (incl. zeros/NaN)
+    zero_count: jax.Array  # [n_streams]
+    neg_count: jax.Array  # [n_streams] weight of v < 0 lanes
+    sum: jax.Array  # [n_streams]
+    min: jax.Array  # [n_streams]
+    max: jax.Array  # [n_streams]
+    powers: jax.Array  # [n_streams, k]
+    log_powers: jax.Array  # [n_streams, k]
+
+    @property
+    def n_streams(self) -> int:
+        return self.count.shape[-1]
+
+    @property
+    def n_moments(self) -> int:
+        return self.powers.shape[-1]
+
+
+def init(spec: SketchSpec, n_streams: int) -> MomentState:
+    """Allocate an empty moment batch (``spec.n_moments`` power sums).
+    Empty streams answer NaN from :func:`quantile` until mass arrives."""
+    k = spec.n_moments
+    dt = spec.dtype
+    z1 = jnp.zeros((n_streams,), dt)
+    return MomentState(
+        count=z1,
+        zero_count=jnp.zeros_like(z1),
+        neg_count=jnp.zeros_like(z1),
+        sum=jnp.zeros_like(z1),
+        min=jnp.full((n_streams,), jnp.inf, dt),
+        max=jnp.full((n_streams,), -jnp.inf, dt),
+        powers=jnp.zeros((n_streams, k), dt),
+        log_powers=jnp.zeros((n_streams, k), dt),
+    )
+
+
+def bytes_per_stream(spec: SketchSpec) -> int:
+    """Device bytes per stream of the moment state (the contract the
+    backend exists for; ``<= 256`` at every legal ``n_moments``).
+    Never raises."""
+    itemsize = jnp.dtype(spec.dtype).itemsize
+    return (6 + 2 * spec.n_moments) * itemsize
+
+
+def add(
+    spec: SketchSpec,
+    mstate: MomentState,
+    values,
+    weights=None,
+) -> MomentState:
+    """Ingest ``values[n_streams, S]`` in ONE fused device dispatch.
+
+    Pure function (jit with donation on ``mstate``).  Lane routing
+    matches the dense tier: ``weights <= 0`` is inert padding, ``|v|``
+    under the dtype's smallest normal takes the zero path, NaN counts
+    into the zero path and poisons ``sum``.  Power sums accumulate by
+    ``k`` fused multiply-accumulates -- no scatter, no bins.
+    """
+    v = jnp.asarray(values).astype(spec.dtype)
+    if v.ndim == 1:
+        v = v[:, None]
+    if weights is None:
+        w = jnp.ones_like(v)
+    else:
+        w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
+    live = w > 0
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    absv = jnp.abs(v)
+    routable = jnp.logical_and(live, absv >= tiny)  # NaN fails -> zero path
+    zeroish = jnp.logical_and(live, jnp.logical_not(absv >= tiny))
+    wl = jnp.where(routable, w, 0)
+    x = jnp.where(routable, v, 0)
+    lx = jnp.log(jnp.where(routable, absv, jnp.asarray(1.0, v.dtype)))
+    p_terms = []
+    l_terms = []
+    xt = jnp.ones_like(v)
+    lt = jnp.ones_like(v)
+    for _ in range(spec.n_moments):
+        xt = xt * x
+        lt = lt * lx
+        p_terms.append((wl * xt).sum(-1))
+        l_terms.append((wl * lt).sum(-1))
+    inf = jnp.asarray(jnp.inf, spec.dtype)
+    finite_live = jnp.logical_and(live, jnp.logical_not(jnp.isnan(v)))
+    w_live = jnp.where(live, w, 0)
+    return MomentState(
+        count=mstate.count + w_live.sum(-1),
+        zero_count=mstate.zero_count + jnp.where(zeroish, w, 0).sum(-1),
+        neg_count=mstate.neg_count
+        + jnp.where(jnp.logical_and(routable, v < 0), w, 0).sum(-1),
+        sum=mstate.sum + (jnp.where(live, v, 0) * w_live).sum(-1),
+        min=jnp.minimum(mstate.min, jnp.where(finite_live, v, inf).min(-1)),
+        max=jnp.maximum(mstate.max, jnp.where(finite_live, v, -inf).max(-1)),
+        powers=mstate.powers + jnp.stack(p_terms, axis=-1),
+        log_powers=mstate.log_powers + jnp.stack(l_terms, axis=-1),
+    )
+
+
+def merge(spec: SketchSpec, a: MomentState, b: MomentState) -> MomentState:
+    """Merged batch == having ingested both streams (elementwise adds,
+    min/min, max/max).  Bit-exact up to f32 addition rounding; empty
+    operands are exact identities.  Pure function."""
+    return MomentState(
+        count=a.count + b.count,
+        zero_count=a.zero_count + b.zero_count,
+        neg_count=a.neg_count + b.neg_count,
+        sum=a.sum + b.sum,
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+        powers=a.powers + b.powers,
+        log_powers=a.log_powers + b.log_powers,
+    )
+
+
+def merge_axis(spec: SketchSpec, mstate: MomentState, axis: int = 0):
+    """Reduce stacked ``[K, n_streams, ...]`` partials over ``axis``
+    (the tree-reduction form of :func:`merge`; empty stacks are a
+    caller error and raise through jnp)."""
+    return MomentState(
+        count=mstate.count.sum(axis),
+        zero_count=mstate.zero_count.sum(axis),
+        neg_count=mstate.neg_count.sum(axis),
+        sum=mstate.sum.sum(axis),
+        min=mstate.min.min(axis),
+        max=mstate.max.max(axis),
+        powers=mstate.powers.sum(axis),
+        log_powers=mstate.log_powers.sum(axis),
+    )
+
+
+def psum_merge(mstate: MomentState, axis_name) -> MomentState:
+    """Collective form of :func:`merge` over mesh axes (must run inside
+    ``shard_map``/pmap; a tuple of axes folds innermost-first like the
+    dense tier's hierarchical fold).  Sums psum, extrema pmin/pmax --
+    bit-exact for the integer-valued counters, f32-rounded sums as
+    documented."""
+    from jax import lax
+
+    from sketches_tpu.parallel import _value_axes
+
+    for ax in reversed(_value_axes(axis_name)):
+        mstate = MomentState(
+            count=lax.psum(mstate.count, ax),
+            zero_count=lax.psum(mstate.zero_count, ax),
+            neg_count=lax.psum(mstate.neg_count, ax),
+            sum=lax.psum(mstate.sum, ax),
+            min=lax.pmin(mstate.min, ax),
+            max=lax.pmax(mstate.max, ax),
+            powers=lax.psum(mstate.powers, ax),
+            log_powers=lax.psum(mstate.log_powers, ax),
+        )
+    return mstate
+
+
+def fold_hosts(spec: SketchSpec, mstates: Sequence[MomentState],
+               reachable=None):
+    """Cross-host fold of per-host moment partials ->
+    ``(folded MomentState, ShardLossReport)``.
+
+    Same protocol shape as the dense :func:`sketches_tpu.parallel.fold_hosts`:
+    unreachable hosts (explicit mask, or the armed ``dcn.partition``
+    fault site) are folded AROUND with their mass accounted in the
+    report -- detected, never silently zeroed; no host reachable raises
+    ``ShardLossError``; an empty or shape-mismatched stack raises
+    ``SketchValueError``.
+    """
+    from sketches_tpu import faults, resilience
+    from sketches_tpu.resilience import (
+        ShardLossError,
+        ShardLossReport,
+        SketchValueError,
+    )
+
+    n_hosts = len(mstates)
+    if n_hosts == 0:
+        raise SketchValueError("fold_hosts needs at least one host state")
+    shapes = {tuple(st.powers.shape) for st in mstates}
+    if len(shapes) != 1:
+        raise SketchValueError(
+            f"fold_hosts needs equal-shape host states; got {shapes}"
+        )
+    if reachable is None:
+        reach = np.ones((n_hosts,), bool)
+        part = faults.partitioned_hosts(n_hosts) if faults._ACTIVE else ()
+        if part:
+            reach[list(part)] = False
+    else:
+        reach = np.asarray(reachable, bool).reshape(-1)
+        if reach.shape[0] != n_hosts:
+            raise SketchValueError(
+                f"reachable mask length {reach.shape[0]} != {n_hosts} hosts"
+            )
+    if not reach.any():
+        raise ShardLossError(
+            f"all {n_hosts} hosts unreachable across DCN; nothing to fold"
+        )
+    live = [st for st, r in zip(mstates, reach) if r]
+    folded = live[0]
+    for st in live[1:]:
+        folded = merge(spec, folded, st)
+    counts = np.stack(
+        [np.asarray(jax.device_get(st.count), np.float64) for st in mstates]
+    )
+    report = ShardLossReport(
+        live=reach,
+        surviving_count=counts[reach].sum(0),
+        dropped_count=counts[~reach].sum(0),
+    )
+    if not reach.all():
+        resilience.bump("dcn.partitions", int((~reach).sum()))
+    return folded, report
+
+
+# ---------------------------------------------------------------------------
+# Host-side maximum-entropy quantile solve
+# ---------------------------------------------------------------------------
+
+
+def _std_power_moments(sums: np.ndarray, mass: float, c: float, s: float,
+                       k: int) -> np.ndarray:
+    """Raw power sums -> standardized moments ``E[((t-c)/s)**j]``,
+    ``j = 0..k`` (f64 binomial shift; the classic msketch conversion).
+    Returns NaN-free prefix only -- the caller trims at the first
+    non-finite entry."""
+    e = np.empty(k + 1, np.float64)
+    e[0] = 1.0
+    e[1:] = sums[:k] / mass
+    out = np.empty(k + 1, np.float64)
+    for j in range(k + 1):
+        acc = 0.0
+        for i in range(j + 1):
+            acc += math.comb(j, i) * e[i] * (-c) ** (j - i)
+        out[j] = acc / s**j
+    return out
+
+
+def _cheb_moments(std: np.ndarray) -> np.ndarray:
+    """Standardized power moments -> Chebyshev moments ``E[T_j(y)]``
+    (exact linear map; f64)."""
+    from numpy.polynomial import chebyshev as C
+
+    k = std.shape[0] - 1
+    out = np.empty(k + 1, np.float64)
+    for j in range(k + 1):
+        coef = C.cheb2poly(np.eye(j + 1, dtype=np.float64)[j])
+        out[j] = float((coef * std[: coef.shape[0]]).sum())
+    return out
+
+
+def _maxent_density(mu: np.ndarray) -> Optional[np.ndarray]:
+    """Newton-solve the maxent dual for Chebyshev moments ``mu`` ->
+    grid density ``[|_GRID|]`` (normalized to sum 1), or None when the
+    solve fails to converge (the caller falls back to fewer moments)."""
+    from numpy.polynomial import chebyshev as C
+
+    k = mu.shape[0] - 1
+    y = (np.arange(_GRID, dtype=np.float64) + 0.5) / _GRID * 2.0 - 1.0
+    dy = 2.0 / _GRID
+    t = C.chebvander(y, k)  # [_GRID, k+1]
+    del dy  # normalization is explicit below; the measure scale cancels
+    lam = np.zeros(k, np.float64)  # lambda_1..k; T_0's weight = log Z
+    t1 = t[:, 1:]
+    for _ in range(_MAX_NEWTON):
+        logp = t1 @ lam
+        logp -= logp.max()  # overflow guard
+        p = np.exp(logp)
+        p /= p.sum()  # probability masses on the grid
+        e_t = (t1 * p[:, None]).sum(0)  # E_p[T_j], j=1..k
+        g = e_t - mu[1:]
+        if not np.all(np.isfinite(g)):
+            return None
+        if np.abs(g).max() < 1e-9:
+            return p
+        # Newton on the normalized dual: Hessian = Cov_p[T_i, T_j].
+        h = (t1.T * p) @ t1 - np.outer(e_t, e_t)
+        h += np.eye(k) * 1e-10
+        try:
+            step = np.linalg.solve(h, g)
+        except np.linalg.LinAlgError:
+            return None
+        norm = np.abs(step).max()
+        if norm > 4.0:  # damping: long steps overshoot the dual
+            step *= 4.0 / norm
+        lam -= step
+    logp = t1 @ lam
+    p = np.exp(logp - logp.max())
+    if not np.all(np.isfinite(p)) or p.sum() <= 0:
+        return None
+    return p / p.sum()
+
+
+def _finite_prefix(arr: np.ndarray) -> int:
+    """Length of the leading finite run (f32 power sums can saturate at
+    high orders; the solver uses only the trustworthy prefix)."""
+    bad = ~np.isfinite(arr)
+    return int(np.argmax(bad)) if bad.any() else arr.shape[0]
+
+
+#: Relative error budget of the f32-accumulated power sums (rounding
+#: per fused add, batch reductions, merges; measured ~1e-6 end to end,
+#: budgeted with slack).
+_F32_SUM_ERR = 3e-6
+
+#: Largest Chebyshev-moment absolute error the maxent solve tolerates
+#: before a moment order does more harm than good.
+_MOMENT_TOL = 5e-3
+
+
+def _trusted_order(a: float, b: float, k: int) -> int:
+    """Highest moment order whose Chebyshev moment survives f32 noise.
+
+    Two amplifiers sit between the device's f32 power sums and the
+    solver's Chebyshev moments: the binomial standardization shift
+    (``((M + |c|) / s) ** j`` with ``M = max(|a|, |b|)``) and the
+    power->Chebyshev conversion (leading coefficient ``2**(j-1)``).
+    Orders whose amplified noise exceeds :data:`_MOMENT_TOL` are noise,
+    not signal -- fitting them makes the density strictly worse (the
+    observed failure mode on log-asymmetric supports like
+    ``uniform(1, 100)``).  Symmetric supports (``c ~ 0``, e.g.
+    lognormal in log space) keep their full order.  Always >= 2.
+    """
+    c, s = (a + b) / 2.0, (b - a) / 2.0
+    if s <= 0:
+        return 2
+    amp = (max(abs(a), abs(b)) + abs(c)) / s
+    order = 2
+    for j in range(2, k + 1):
+        if _F32_SUM_ERR * (amp**j) * (2.0 ** max(j - 1, 0)) > _MOMENT_TOL:
+            break
+        order = j
+    return order
+
+
+def _stream_quantiles(
+    k: int, count: float, zero: float, neg: float, vmin: float,
+    vmax: float, powers: np.ndarray, log_powers: np.ndarray,
+    qs: np.ndarray,
+) -> Tuple[np.ndarray, bool]:
+    """One stream's maxent quantiles -> ``(values[Q], used_fallback)``.
+
+    NaN row for an empty stream; zero-only streams answer 0; constant
+    streams answer the constant.  The basis is log-moments for
+    all-positive streams (the accurate choice for long tails), raw
+    power moments otherwise.
+    """
+    if not count > 0:
+        return np.full(qs.shape, np.nan), False
+    nz = count - zero
+    if not nz > 0:  # all mass in the zero bucket
+        return np.zeros(qs.shape), False
+    if not (np.isfinite(vmin) and np.isfinite(vmax)):
+        return np.full(qs.shape, np.nan), False
+    use_log = vmin > 0.0
+    if use_log:
+        a, b = math.log(vmin), math.log(vmax)
+        sums = log_powers
+    else:
+        a, b = vmin, vmax
+        sums = powers
+    fallback = False
+    if b - a < 1e-12 * max(1.0, abs(a)):
+        density = np.full(_GRID, 1.0 / _GRID)
+        a = b = (a + b) / 2.0
+        grid = np.full(_GRID, a)
+    else:
+        c, s = (a + b) / 2.0, (b - a) / 2.0
+        kk = min(k, _finite_prefix(sums), _trusted_order(a, b, k))
+        density = None
+        while kk >= 2:
+            std = _std_power_moments(sums, nz, c, s, kk)
+            if np.all(np.isfinite(std)):
+                mu = _cheb_moments(std)
+                density = _maxent_density(mu)
+                if density is not None:
+                    break
+            fallback = True
+            kk //= 2
+        if density is None:  # 0-moment maxent: uniform on [a, b]
+            fallback = True
+            density = np.full(_GRID, 1.0 / _GRID)
+        y = (np.arange(_GRID, dtype=np.float64) + 0.5) / _GRID * 2.0 - 1.0
+        grid = c + s * y
+    if use_log:
+        grid = np.exp(grid)
+    # Mixture CDF over sorted support: continuous part (weight nz) plus
+    # a point mass at 0 (weight zero).  ``grid`` is increasing in value
+    # space for both bases (exp is monotone).
+    w = density * nz
+    if zero > 0:
+        pos = int(np.searchsorted(grid, 0.0))
+        grid = np.insert(grid, pos, 0.0)
+        w = np.insert(w, pos, zero)
+    cdf = np.cumsum(w) / count
+    idx = np.searchsorted(cdf, np.clip(qs, 0.0, 1.0), side="left")
+    idx = np.clip(idx, 0, grid.shape[0] - 1)
+    out = grid[idx]
+    valid = (qs >= 0.0) & (qs <= 1.0)
+    return np.where(valid, out, np.nan), fallback
+
+
+def quantile(spec: SketchSpec, mstate: MomentState, qs) -> np.ndarray:
+    """Quantile values for ``qs[Q]`` across the batch -> ``[n_streams, Q]``.
+
+    Host-side solve (one maxent Newton per nonempty stream -- the
+    moment backend trades query CPU for ~100x less device memory);
+    empty streams and out-of-range q answer NaN; failed solves fall
+    back down the moment ladder (counted, never raised).  Accuracy is
+    the documented moment-truncation envelope, NOT the dense alpha
+    contract.
+    """
+    qs_arr = np.atleast_1d(np.asarray(qs, np.float64))
+    host = jax.device_get(
+        (mstate.count, mstate.zero_count, mstate.neg_count, mstate.min,
+         mstate.max, mstate.powers, mstate.log_powers)
+    )
+    count, zero, neg, vmin, vmax, powers, log_powers = (
+        np.asarray(x, np.float64) for x in host
+    )
+    n = count.shape[0]
+    out = np.empty((n, qs_arr.shape[0]), np.float64)
+    n_fallback = 0
+    for i in range(n):
+        out[i], fb = _stream_quantiles(
+            int(mstate.n_moments), float(count[i]), float(zero[i]),
+            float(neg[i]), float(vmin[i]), float(vmax[i]), powers[i],
+            log_powers[i], qs_arr,
+        )
+        n_fallback += bool(fb)
+    if telemetry._ACTIVE:
+        telemetry.counter_inc("backend.moment_solves", float(n))
+        if n_fallback:
+            telemetry.counter_inc(
+                "backend.moment_fallbacks", float(n_fallback)
+            )
+    return out.astype(np.dtype(jnp.dtype(spec.dtype).name))
+
+
+class MomentDDSketch:
+    """Stateful facade for the moment-summary backend.
+
+    Reference-shaped API (``add`` / ``merge`` / ``get_quantile_values``)
+    over :class:`MomentState`; ingest is one fused jit dispatch with
+    state donation, queries run the host maxent solve.  There is no
+    engine ladder -- the single engine reports tier ``"moment"``
+    through :meth:`get_quantile_values_resolved` and ignores tier
+    exclusions (it is its own floor).
+
+    Failure modes: empty streams answer NaN; failed solves fall back
+    (counted), never raise; merging unequal specs raises
+    ``UnequalSketchParametersError``; invalid construction raises
+    ``SpecError``; see the module docstring for the accuracy envelope
+    and the mixed-sign/raw-basis range caveat.
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        relative_accuracy: float = DEFAULT_REL_ACC,
+        n_moments: Optional[int] = None,
+        spec: Optional[SketchSpec] = None,
+        state: Optional[MomentState] = None,
+        engine: str = "auto",  # accepted for facade parity; single engine
+    ):
+        if spec is None:
+            spec = SketchSpec(
+                relative_accuracy=relative_accuracy,
+                backend="moment",
+                n_moments=12 if n_moments is None else n_moments,
+            )
+        if spec.backend != "moment":
+            raise SpecError(
+                f"MomentDDSketch needs backend='moment'; got"
+                f" {spec.backend!r}"
+            )
+        self.spec = spec
+        self._state = init(spec, n_streams) if state is None else state
+        self._add = jax.jit(
+            functools.partial(add, spec), donate_argnums=(0,)
+        )
+        self._merge = jax.jit(
+            functools.partial(merge, spec), donate_argnums=(0,)
+        )
+
+    def add(self, values, weights=None) -> "MomentDDSketch":
+        """Ingest ``values[n_streams, S]`` (one fused dispatch); padding
+        and NaN semantics match the dense tier.  Returns self."""
+        _t0 = telemetry.clock() if telemetry._ACTIVE else None
+        self._state = self._add(self._state, jnp.asarray(values), weights)
+        if _t0 is not None:
+            telemetry.finish_span(
+                "ingest_s", _t0, component="moment", engine="moment"
+            )
+        from sketches_tpu import accuracy
+
+        if accuracy._ACTIVE:
+            accuracy.observe_ingest(self, values, weights)
+        return self
+
+    def get_quantile_value(self, q: float) -> np.ndarray:
+        """Per-stream value at ``q`` -> ``[n_streams]`` (NaN if empty)."""
+        return self.get_quantile_values([q])[:, 0]
+
+    def get_quantile_values(self, quantiles: Sequence[float]) -> np.ndarray:
+        """Maxent multi-quantile -> ``[n_streams, Q]`` (NaN for empty
+        streams / out-of-range q; failed solves fall back, counted)."""
+        return quantile(self.spec, self._state, [float(q) for q in quantiles])
+
+    def get_quantile_values_resolved(
+        self, quantiles: Sequence[float], disabled_tiers: Sequence[str] = (),
+    ):
+        """Serve-tier seam -> ``("moment", values)``.  The single
+        engine ignores ``disabled_tiers`` (it is its own always-
+        answerable floor); failures never tier-degrade -- the solver
+        falls back internally instead."""
+        return "moment", self.get_quantile_values(quantiles)
+
+    def _query_choice(self, qs_tuple, extra_disabled=frozenset()):
+        """Serve-tier seam: the resolved (tier, fn) pair -- always the
+        single ``"moment"`` engine; exclusions are no-ops, never an
+        error."""
+        return (
+            "moment",
+            lambda state, qs_arr: quantile(
+                self.spec, state, np.asarray(qs_arr)
+            ),
+        )
+
+    def merge(self, other: "MomentDDSketch") -> "MomentDDSketch":
+        """Fold ``other`` in (elementwise; consumes neither spec).
+        Raises ``UnequalSketchParametersError`` on spec mismatch."""
+        if not self.mergeable(other):
+            from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+            raise UnequalSketchParametersError(
+                "Cannot merge two moment sketches with different specs"
+            )
+        from sketches_tpu import integrity
+
+        _fp_pre = None
+        if integrity._ACTIVE:
+            _fp_pre = integrity.fingerprint(
+                self.spec, self._state
+            ) + integrity.fingerprint(other.spec, other._state)
+        self._state = self._merge(self._state, other._state)
+        if _fp_pre is not None:
+            integrity.verify_moment_merge(
+                self.spec, self._state, _fp_pre, seam="moment.merge"
+            )
+        return self
+
+    def mergeable(self, other) -> bool:
+        return getattr(other, "spec", None) == self.spec
+
+    @property
+    def state(self) -> MomentState:
+        return self._state
+
+    @state.setter
+    def state(self, new_state: MomentState) -> None:
+        self._state = new_state
+
+    @property
+    def n_streams(self) -> int:
+        return self._state.count.shape[0]
+
+    @property
+    def count(self) -> jax.Array:
+        return self._state.count
+
+    @property
+    def sum(self) -> jax.Array:  # noqa: A003 - reference API name
+        return self._state.sum
+
+    @property
+    def relative_accuracy(self) -> float:
+        return self.spec.relative_accuracy
+
+    def bytes_per_stream(self) -> int:
+        """Device bytes per stream (~100 at the default k; never
+        raises)."""
+        return bytes_per_stream(self.spec)
+
+    def __repr__(self) -> str:
+        return (
+            f"MomentDDSketch(n_streams={self.n_streams},"
+            f" n_moments={self.spec.n_moments},"
+            f" bytes_per_stream={self.bytes_per_stream()})"
+        )

@@ -1,0 +1,997 @@
+"""UDDSketch-style uniform-collapse backend: alpha degrades, tails don't.
+
+The dense device store clamps out-of-window keys into its edge bins:
+mass is conserved but the tail quantiles silently corrupt, and the only
+signal is the ``collapsed_low/high`` counters.  UDDSketch
+(arXiv:2004.08604) replaces that failure mode with *uniform collapse*:
+merge every adjacent bin pair, so the mapping's gamma squares
+(``gamma -> gamma**2``), resolution halves EVERYWHERE, and the
+relative-accuracy guarantee degrades predictably to
+
+    alpha_eff(level) = (gamma**(2**level) - 1) / (gamma**(2**level) + 1)
+
+instead of the tails becoming silently unbounded.
+
+Level algebra (logarithmic mapping only -- enforced by ``SketchSpec``):
+the base key of ``v`` is ``k0 = ceil(log_gamma v)`` and the level-L key
+is ``ceil(k0 / 2**L)`` (``ceil(ceil(x)/m) == ceil(x/m)`` makes the
+composition exact), so
+
+* **ingest rides the batched/Pallas engines unchanged**: values for a
+  collapsed stream are pre-mapped to the base-mapping representative of
+  their level key (:func:`premap_values`, one tiny elementwise device
+  op), after which the stock ingest scatters them into the right
+  physical bin;
+* **collapse is a pure state transform** (:func:`collapse_once`): bin
+  mass at level key ``k`` scatters to ``ceil(k / 2)``, the per-stream
+  window offset follows, and the per-stream ``level`` increments --
+  mass exactly conserved, derived arrays recomputed from the rolled
+  bins;
+* **query post-corrects the decode** (:func:`correct_values`): the
+  stock engines decode a level key ``k`` with the base mapping
+  (``gamma**k * 2/(1+gamma)``); the level-true value is
+  ``gamma_L**k * 2/(1+gamma_L)``, an exp of an affine function of
+  ``k`` -- one elementwise op on the ``[n_streams, Q]`` result, riding
+  whatever engine tier answered.
+
+Merging mixed-gamma operands collapses the finer operand first
+(:func:`collapse_to` to the pairwise max level), which commutes with
+merge exactly (collapse is linear in the bins), and the armed integrity
+layer fingerprints the *aligned* operands so the merge seam stays
+fingerprint-accounted.
+
+Failure modes: a collapse trigger (or explicit :meth:`collapse`) with
+``SKETCHES_TPU_ADAPTIVE=0`` raises ``SpecError`` -- the kill switch
+refuses loudly instead of degrading alpha silently; streams at
+``spec.max_collapses`` stop collapsing and fall back to edge-clamping
+(counted, as ever); quantiles of empty streams answer NaN; merging
+unequal specs raises ``UnequalSketchParametersError``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sketches_tpu import batched, integrity, telemetry, tracing
+from sketches_tpu.analysis import registry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
+from sketches_tpu.mapping import zero_threshold as mapping_zero_threshold
+from sketches_tpu.resilience import SpecError
+
+__all__ = [
+    "AdaptiveState",
+    "AdaptiveDDSketch",
+    "init",
+    "effective_gamma",
+    "effective_alpha",
+    "premap_values",
+    "collapse_once",
+    "collapse_to",
+    "correct_values",
+    "quantile",
+    "merge",
+    "psum_merge",
+    "fold_hosts",
+]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class AdaptiveState:
+    """Uniform-collapse device state: the dense base + per-stream level.
+
+    ``base`` is a stock :class:`SketchState` whose bins hold mass at
+    *level keys* (``ceil(base_key / 2**level)``); ``level`` is the
+    per-stream collapse count (int32, 0 = base gamma).  Registered as a
+    pytree, so it stacks/concats/psums exactly like the dense state.
+    Empty streams answer NaN through :func:`quantile` like the dense
+    tier; the pass-through properties keep collapse observability
+    (``collapsed_low/high``) readable by the accuracy audit.
+    """
+
+    base: SketchState
+    level: jax.Array  # [n_streams] int32
+
+    @property
+    def n_streams(self) -> int:
+        return self.base.n_streams
+
+    @property
+    def count(self) -> jax.Array:
+        return self.base.count
+
+    @property
+    def zero_count(self) -> jax.Array:
+        return self.base.zero_count
+
+    @property
+    def collapsed_low(self) -> jax.Array:
+        return self.base.collapsed_low
+
+    @property
+    def collapsed_high(self) -> jax.Array:
+        return self.base.collapsed_high
+
+
+def init(spec: SketchSpec, n_streams: int) -> AdaptiveState:
+    """Empty adaptive batch: dense init + all-zero levels.  Raises
+    ``SpecError`` (via the spec) for a non-logarithmic mapping."""
+    return AdaptiveState(
+        base=batched.init(spec, n_streams),
+        level=jnp.zeros((n_streams,), jnp.int32),
+    )
+
+
+def effective_gamma(spec: SketchSpec, level) -> jax.Array:
+    """Per-stream realized gamma: ``gamma ** (2 ** level)`` (f32)."""
+    lng = jnp.float32(math.log(spec.gamma))
+    return jnp.exp(jnp.exp2(jnp.asarray(level, jnp.float32)) * lng)
+
+
+def effective_alpha(spec: SketchSpec, level) -> jax.Array:
+    """Per-stream realized relative-accuracy bound.
+
+    ``(g - 1) / (g + 1)`` with ``g = gamma ** (2 ** level)``: equals
+    ``spec.relative_accuracy`` at level 0 and degrades toward (but
+    never reaches) 1 as collapses accumulate.  Computed via ``tanh`` of
+    the half-log for f32 stability at deep levels (where ``g``
+    overflows f32 but alpha is just below 1).
+    """
+    lng = jnp.float32(math.log(spec.gamma))
+    half = 0.5 * jnp.exp2(jnp.asarray(level, jnp.float32)) * lng
+    return jnp.tanh(half)
+
+
+def _ceil_div(k: jax.Array, m: jax.Array) -> jax.Array:
+    """Elementwise ``ceil(k / m)`` for int32 ``k`` (any sign), ``m > 0``."""
+    return -((-k) // m)
+
+
+def premap_values(spec: SketchSpec, level: jax.Array, values) -> jax.Array:
+    """Map raw values to base-mapping stand-ins for their level keys.
+
+    For a stream at level L, the physical bins hold *level keys*
+    ``ceil(base_key / 2**L)``; the stock ingest computes base keys, so
+    each value is replaced by ``mapping.value(level_key)`` -- the base
+    representative whose base key IS the level key (round-trip exact:
+    the representative sits at the log-space midpoint of its bucket, so
+    f32 rounding has ~0.5 bucket of margin).  Level-0 streams pass
+    through untouched (bit-identical to the dense backend).  Zeros,
+    NaNs, and subnormals pass through (they take the zero path / sum
+    poisoning exactly as :func:`sketches_tpu.batched.add` documents);
+    signs are preserved.  Note the collapsed streams' ``sum/min/max``
+    bookkeeping then tracks the representatives -- within
+    ``effective_alpha`` of the raw values, the documented contract.
+    """
+    v = jnp.asarray(values).astype(spec.dtype)
+    if v.ndim == 1:
+        v = v[:, None]
+    lam = jnp.asarray(level, jnp.int32)[:, None]  # [N, 1]
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    absv = jnp.abs(v)
+    routable = absv >= tiny  # NaN fails -> passes through untouched
+    neutral = jnp.where(routable, absv, jnp.asarray(1.0, spec.dtype))
+    k0 = spec.mapping.key_array(neutral)  # base keys [N, S]
+    m = jnp.int32(1) << jnp.minimum(lam, 30)
+    k_level = _ceil_div(k0, m)
+    rep = spec.mapping.value_array(k_level, dtype=spec.dtype)
+    u = jnp.where(
+        jnp.logical_and(routable, lam > 0), jnp.sign(v) * rep, v
+    )
+    return u
+
+
+def clamp_fraction(
+    spec: SketchSpec, key_offset: jax.Array, level: jax.Array, values,
+    weights=None,
+) -> jax.Array:
+    """Fraction of a batch's mass that would edge-clamp -> ``[n_streams]``.
+
+    The pre-ingest collapse guard's predictor: the weighted fraction of
+    live nonzero lanes whose level key falls outside the stream's
+    current window.  Pure, jit-safe, one pass over the batch (no
+    scatter); streams with no live nonzero lanes answer 0 (nothing can
+    clamp).  NaN and padding lanes are excluded exactly like ingest.
+    """
+    v = jnp.asarray(values).astype(spec.dtype)
+    if v.ndim == 1:
+        v = v[:, None]
+    if weights is None:
+        w = jnp.ones_like(v)
+    else:
+        w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
+    live = w > 0
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    absv = jnp.abs(v)
+    routable = jnp.logical_and(live, absv >= tiny)
+    neutral = jnp.where(routable, absv, jnp.asarray(1.0, spec.dtype))
+    k0 = spec.mapping.key_array(neutral)
+    m = jnp.int32(1) << jnp.minimum(
+        jnp.asarray(level, jnp.int32)[:, None], 30
+    )
+    k_level = _ceil_div(k0, m)
+    lo = jnp.asarray(key_offset, jnp.int32)[:, None]
+    hi = lo + jnp.int32(spec.n_bins - 1)
+    out = jnp.logical_and(
+        routable, jnp.logical_or(k_level < lo, k_level > hi)
+    )
+    w_out = jnp.where(out, w, 0).sum(-1)
+    w_all = jnp.where(routable, w, 0).sum(-1)
+    return w_out / jnp.maximum(w_all, 1)
+
+
+def level_auto_offset(
+    spec: SketchSpec, level: jax.Array, key_offset: jax.Array, values,
+    weights=None,
+) -> jax.Array:
+    """Window offsets centering each stream on a batch's median LEVEL key.
+
+    The level-aware twin of :func:`sketches_tpu.batched.auto_offset`
+    (same median-of-keys policy, same padding exclusions), used by the
+    pre-ingest guard to ask "would a recenter at the CURRENT level fit
+    this batch?" before paying a collapse for it.  Streams with no live
+    nonzero values keep their current offset; pure and jit-safe.
+    """
+    v = jnp.asarray(values).astype(spec.dtype)
+    if v.ndim == 1:
+        v = v[:, None]
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    nonzero = jnp.abs(v) >= tiny  # NaN fails -> excluded
+    if weights is not None:
+        w = jnp.broadcast_to(jnp.asarray(weights, spec.dtype), v.shape)
+        nonzero = jnp.logical_and(nonzero, w > 0)
+    absv = jnp.where(nonzero, jnp.abs(v), jnp.asarray(1.0, spec.dtype))
+    k0 = spec.mapping.key_array(absv)
+    m = jnp.int32(1) << jnp.minimum(
+        jnp.asarray(level, jnp.int32)[:, None], 30
+    )
+    keys = _ceil_div(k0, m)
+    big = jnp.int32(2**30)
+    ksort = jnp.sort(jnp.where(nonzero, keys, big), axis=-1)
+    n_live = nonzero.sum(-1)
+    mid = jnp.maximum((n_live - 1) // 2, 0)
+    med = jnp.take_along_axis(
+        ksort, mid[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    centered = med - jnp.int32(batched._center_bin(spec))
+    return jnp.where(
+        n_live > 0, centered, jnp.asarray(key_offset, jnp.int32)
+    ).astype(jnp.int32)
+
+
+def _collapse_body(spec: SketchSpec, state: SketchState, mask: jax.Array):
+    """One uniform collapse of the masked streams' bins (pure, device).
+
+    Level key ``k`` scatters to ``ceil(k / 2)``; the window offset
+    follows (``ceil(key_offset / 2)``), so post-collapse occupancy sits
+    in the lower half of the window -- the freed headroom is the
+    mechanism that ends an edge-clamping episode.  Unmasked rows are
+    bit-identical pass-throughs.  Mass is exactly conserved (the
+    scatter moves every bin); derived arrays (occupied bounds, tile
+    sums) recompute from the rolled bins.
+    """
+    n_bins = spec.n_bins
+    koff = state.key_offset  # [N] level keys' low edge
+    new_koff = jnp.where(mask, _ceil_div(koff, jnp.int32(2)), koff)
+    iota = jnp.arange(n_bins, dtype=jnp.int32)
+    old_key = koff[:, None] + iota[None, :]  # [N, B]
+    tgt = _ceil_div(old_key, jnp.int32(2)) - new_koff[:, None]
+    idx = jnp.where(
+        mask[:, None], jnp.clip(tgt, 0, n_bins - 1), iota[None, :]
+    )
+
+    def _roll_row(bins_row, idx_row):
+        return jnp.zeros_like(bins_row).at[idx_row].add(bins_row)
+
+    roll = jax.vmap(_roll_row)
+    new_pos = roll(state.bins_pos, idx)
+    new_neg = roll(state.bins_neg, idx)
+    pos_lo, pos_hi = batched._occupied_bounds(new_pos)
+    neg_lo, neg_hi = batched._occupied_bounds(new_neg)
+    return dataclasses.replace(
+        state,
+        bins_pos=new_pos,
+        bins_neg=new_neg,
+        key_offset=new_koff,
+        pos_lo=pos_lo,
+        pos_hi=pos_hi,
+        neg_lo=neg_lo,
+        neg_hi=neg_hi,
+        tile_sums=batched.tile_sums_of(new_pos, new_neg),
+    )
+
+
+def collapse_once(
+    spec: SketchSpec, astate: AdaptiveState, mask=None
+) -> AdaptiveState:
+    """Collapse the masked streams one level (gamma -> gamma**2).
+
+    ``mask`` is a ``[n_streams]`` bool (default: all streams); streams
+    already at ``spec.max_collapses`` are excluded -- they keep their
+    level and fall back to edge-clamping (counted by the collapse
+    counters as ever).  Pure function, jit-safe; mass exactly conserved.
+    """
+    if mask is None:
+        mask = jnp.ones((astate.n_streams,), bool)
+    mask = jnp.logical_and(
+        jnp.asarray(mask, bool), astate.level < spec.max_collapses
+    )
+    return AdaptiveState(
+        base=_collapse_body(spec, astate.base, mask),
+        level=astate.level + mask.astype(jnp.int32),
+    )
+
+
+def collapse_to(
+    spec: SketchSpec, astate: AdaptiveState, target_level
+) -> AdaptiveState:
+    """Collapse each stream up to ``target_level`` (scalar or [N]).
+
+    Streams already at or past their target are untouched (levels never
+    decrease -- resolution, once lost, is lost).  Unrolls
+    ``spec.max_collapses`` single collapses (jit-safe static bound), so
+    keep ``max_collapses`` modest.  Mass exactly conserved.
+    """
+    target = jnp.broadcast_to(
+        jnp.asarray(target_level, jnp.int32), astate.level.shape
+    )
+    for _ in range(spec.max_collapses):
+        astate = collapse_once(spec, astate, astate.level < target)
+    return astate
+
+
+def correct_values(spec: SketchSpec, level: jax.Array, vals) -> jax.Array:
+    """Re-decode base-mapping query output at each stream's true level.
+
+    The stock engines answer ``gamma**k * 2/(1+gamma)`` for a chosen
+    level key ``k``; the level-true representative is
+    ``gamma_L**k * 2/(1+gamma_L)``.  The key is recovered exactly from
+    the base decode (it sits mid-bucket in log space) and the corrected
+    value is computed as one fused ``exp`` of an affine function of
+    ``k`` -- overflow-safe via ``logaddexp`` (saturating like
+    ``value_array``; quantiles stay finite).  Level-0 rows, zeros, and
+    NaNs pass through bit-identically.
+    """
+    v = jnp.asarray(vals)
+    lam = jnp.asarray(level, jnp.int32)
+    lam = lam.reshape(lam.shape + (1,) * (v.ndim - 1))  # [N, 1...] vs [N, Q]
+    tiny = jnp.asarray(mapping_zero_threshold(v.dtype), v.dtype)
+    absv = jnp.abs(v)
+    routable = absv >= tiny  # NaN fails -> untouched
+    neutral = jnp.where(routable, absv, jnp.asarray(1.0, v.dtype))
+    k = spec.mapping.key_array(neutral).astype(jnp.float32)  # level key
+    m = jnp.exp2(jnp.minimum(lam, 64).astype(jnp.float32))
+    lng = jnp.float32(math.log(spec.gamma))
+    # log of gamma_L**k * 2/(1+gamma_L)  =  k*m*ln(g) + ln2 - log1p(g**m)
+    log_out = (
+        k * m * lng
+        + jnp.float32(math.log(2.0))
+        - jnp.logaddexp(jnp.float32(0.0), m * lng)
+    )
+    fin = jnp.finfo(v.dtype)
+    corrected = jnp.clip(
+        jnp.exp(log_out),
+        jnp.asarray(fin.tiny, v.dtype),
+        jnp.asarray(fin.max, v.dtype),
+    ).astype(v.dtype)
+    return jnp.where(
+        jnp.logical_and(routable, lam > 0),
+        jnp.sign(v) * corrected,
+        v,
+    )
+
+
+def quantile(spec: SketchSpec, astate: AdaptiveState, qs) -> jax.Array:
+    """Level-corrected fused multi-quantile -> ``[n_streams, Q]``.
+
+    The dense rank selection runs unchanged on the base state; the
+    decode is then re-done at each stream's level
+    (:func:`correct_values`).  Answers are within
+    ``effective_alpha(spec, level)`` of the true quantiles; empty
+    streams and out-of-range q answer NaN exactly like the dense tier.
+    """
+    return correct_values(
+        spec, astate.level, batched.quantile(spec, astate.base, qs)
+    )
+
+
+def _union_span(spec: SketchSpec, sa: SketchState, sb: SketchState):
+    """Combined occupied absolute-key bounds of two bases ->
+    ``(lo [N], hi [N], occupied [N])`` (sentinel-safe; empty pairs
+    report ``occupied=False``)."""
+    big = jnp.int32(2**30)
+
+    def _bounds(st):
+        has = st.occ_hi >= 0
+        lo = jnp.where(has, st.key_offset + st.occ_lo, big)
+        hi = jnp.where(has, st.key_offset + st.occ_hi, -big)
+        return lo, hi
+
+    la, ha = _bounds(sa)
+    lb, hb = _bounds(sb)
+    lo = jnp.minimum(la, lb)
+    hi = jnp.maximum(ha, hb)
+    occupied = jnp.logical_or(sa.occ_hi >= 0, sb.occ_hi >= 0)
+    return lo, hi, occupied
+
+
+def align_for_merge(
+    spec: SketchSpec, a: AdaptiveState, b: AdaptiveState
+):
+    """Bring two operands onto one (level, window) per stream ->
+    ``(a', b')`` ready for an elementwise merge.
+
+    Three mass-conserving steps, all pure: (1) the finer operand
+    collapses to the pairwise max level; (2) while the operands'
+    combined occupied span cannot fit one window, BOTH collapse further
+    (``gamma -> gamma**2`` beats folding disjoint regimes into edge
+    bins -- the whole point of the backend); streams at
+    ``spec.max_collapses`` stop and will fold (counted); (3) both
+    recenter onto a shared union-centered window.  Levels in the
+    result are equal by construction.
+    """
+    target = jnp.maximum(a.level, b.level)
+    a = collapse_to(spec, a, target)
+    b = collapse_to(spec, b, target)
+    for _ in range(spec.max_collapses):
+        lo, hi, occupied = _union_span(spec, a.base, b.base)
+        span = hi - lo + 1
+        need = jnp.logical_and(
+            jnp.logical_and(occupied, span > spec.n_bins),
+            a.level < spec.max_collapses,
+        )
+        a = collapse_once(spec, a, need)
+        b = collapse_once(spec, b, need)
+    lo, hi, occupied = _union_span(spec, a.base, b.base)
+    span = jnp.clip(hi - lo + 1, 0, spec.n_bins)
+    koff_t = jnp.where(
+        occupied, lo - (spec.n_bins - span) // 2, a.base.key_offset
+    ).astype(jnp.int32)
+    return (
+        AdaptiveState(batched.recenter(spec, a.base, koff_t), a.level),
+        AdaptiveState(batched.recenter(spec, b.base, koff_t), b.level),
+    )
+
+
+def merge(
+    spec: SketchSpec, a: AdaptiveState, b: AdaptiveState
+) -> AdaptiveState:
+    """Merge mixed-gamma operands: collapse the finer one first.
+
+    Per stream, both operands align through :func:`align_for_merge`
+    (max level, widened until the union fits, shared window), then the
+    bases merge elementwise.  Collapse commutes with merge (it is
+    linear in the bins), so this equals collapsing AFTER the merge --
+    the reference semantics the tests pin.  Mass exactly conserved;
+    pure function; streams at the level cap fold at the edges
+    (counted) rather than failing.
+    """
+    a2, b2 = align_for_merge(spec, a, b)
+    return AdaptiveState(
+        base=batched.merge_aligned(spec, a2.base, b2.base),
+        level=a2.level,
+    )
+
+
+def psum_merge(spec: SketchSpec, astate: AdaptiveState, axis_name):
+    """Collective fold of adaptive partials over mesh axes.
+
+    Must run inside ``shard_map``/pmap.  Levels align first (``pmax``
+    over the axes, then :func:`collapse_to` locally -- the finer
+    operands collapse before any mass crosses the interconnect), then
+    the bases fold through the stock hierarchical
+    :func:`sketches_tpu.parallel.psum_merge`.  Requires the distributed
+    tier's usual discipline (shared init; partials never recentered
+    independently); all-dead axes raise at the caller as ever.
+    """
+    from sketches_tpu.parallel import _pmax_axes, _value_axes
+    from sketches_tpu.parallel import psum_merge as _base_psum
+
+    axes = _value_axes(axis_name)
+    target = _pmax_axes(astate.level, axes)
+    aligned = collapse_to(spec, astate, target)
+    return AdaptiveState(
+        base=_base_psum(aligned.base, axis_name), level=target
+    )
+
+
+def fold_hosts(spec: SketchSpec, astates: Sequence[AdaptiveState],
+               reachable=None):
+    """Cross-host fold of adaptive per-host partials ->
+    ``(folded AdaptiveState, ShardLossReport)``.
+
+    Levels align to the elementwise max over *reachable* hosts (an
+    unreachable host's finer/coarser level must not force survivors to
+    collapse), then the aligned bases fold through the stock
+    :func:`sketches_tpu.parallel.fold_hosts` -- same
+    fingerprint-verified lane, same partition accounting; no host
+    reachable raises ``ShardLossError`` and an empty/mismatched stack
+    raises ``SketchValueError`` exactly as the dense fold does.
+    """
+    from sketches_tpu import parallel
+
+    n_hosts = len(astates)
+    reach = None
+    if reachable is not None:
+        reach = np.asarray(reachable, bool).reshape(-1)
+    levels = np.stack(
+        [np.asarray(jax.device_get(st.level)) for st in astates]
+    )
+    live = reach if reach is not None else np.ones((n_hosts,), bool)
+    if n_hosts and live.shape[0] == n_hosts and live.any():
+        target = levels[live].max(0)
+    else:
+        target = levels.max(0) if n_hosts else levels
+    aligned = [
+        collapse_to(spec, st, jnp.asarray(target)) for st in astates
+    ]
+    folded_base, report = parallel.fold_hosts(
+        spec, [st.base for st in aligned], reachable=reachable
+    )
+    return (
+        AdaptiveState(base=folded_base, level=jnp.asarray(target)),
+        report,
+    )
+
+
+class AdaptiveDDSketch:
+    """Stateful facade for the uniform-collapse backend.
+
+    Wraps a stock :class:`BatchedDDSketch` (the engines -- Pallas
+    ingest, the overlap/tiles/windowed/xla query ladder, the health
+    ladder -- all ride unchanged) and adds the level machinery: ingest
+    premaps values for collapsed streams, the collapse trigger fires
+    when a stream's *recent* edge-clamped mass fraction crosses
+    ``spec.collapse_threshold``, and queries post-correct the decode.
+
+    Failure modes: a firing trigger (or explicit :meth:`collapse`) with
+    ``SKETCHES_TPU_ADAPTIVE=0`` raises ``SpecError`` (the kill switch
+    refuses loudly); streams at ``spec.max_collapses`` stop collapsing
+    and clamp at the edges (counted); merging unequal specs raises
+    ``UnequalSketchParametersError``; empty streams answer NaN; the
+    wrapped engine ladder degrades/raises exactly as the dense facade
+    documents.
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        relative_accuracy: float = batched.DEFAULT_REL_ACC,
+        n_bins: int = batched.DEFAULT_N_BINS,
+        key_offset: Optional[int] = None,
+        spec: Optional[SketchSpec] = None,
+        state: Optional[AdaptiveState] = None,
+        engine: str = "auto",
+        auto_recenter: Optional[bool] = None,
+        bin_dtype=None,
+        collapse_threshold: Optional[float] = None,
+    ):
+        if spec is None:
+            spec = SketchSpec(
+                relative_accuracy=relative_accuracy,
+                mapping_name="logarithmic",
+                n_bins=n_bins,
+                key_offset=key_offset,
+                bin_dtype=bin_dtype,
+                backend="uniform_collapse",
+                collapse_threshold=(
+                    0.01 if collapse_threshold is None else collapse_threshold
+                ),
+            )
+        if spec.backend != "uniform_collapse":
+            raise SpecError(
+                f"AdaptiveDDSketch needs backend='uniform_collapse';"
+                f" got {spec.backend!r}"
+            )
+        self.spec = spec
+        if auto_recenter is None:
+            # The batched facade treats an explicit spec as a pinned
+            # window; the adaptive facade ALWAYS carries a spec, so the
+            # equivalent default is "auto-center unless the caller
+            # pinned the window or restored a state" -- an off-center
+            # window clamps, and clamping is what this backend spends
+            # alpha to avoid.
+            auto_recenter = key_offset is None and state is None
+        self._inner = BatchedDDSketch(
+            n_streams,
+            spec=spec,
+            state=None if state is None else state.base,
+            engine=engine,
+            auto_recenter=auto_recenter,
+        )
+        self._level = (
+            jnp.zeros((n_streams,), jnp.int32)
+            if state is None
+            else jnp.asarray(state.level, jnp.int32)
+        )
+        # Host-cached "any stream collapsed yet" flag: the ingest premap
+        # is an exact no-op at level 0, so fresh facades skip it without
+        # a per-add device fetch.
+        self._any_level = state is not None and bool(
+            np.any(np.asarray(jax.device_get(self._level)) > 0)
+        )
+        # Trigger baseline: edge-clamp counters at the last collapse (or
+        # construction) -- the trigger compares *growth* since then, so
+        # one clamped episode cannot keep re-firing forever.
+        self._trigger_collapsed = np.asarray(
+            jax.device_get(
+                self._inner.state.collapsed_low
+                + self._inner.state.collapsed_high
+            ),
+            np.float64,
+        )
+        self._premap = jax.jit(functools.partial(premap_values, spec))
+        self._clamp_frac = jax.jit(functools.partial(clamp_fraction, spec))
+        self._level_offs = jax.jit(
+            functools.partial(level_auto_offset, spec)
+        )
+
+        def _guard_stats(koff, level, values, weights):
+            # One fused device pass for the pre-ingest guard: clamp
+            # fraction vs the CURRENT window, the batch-median-centered
+            # offsets, and the clamp fraction vs THAT window.
+            frac_now = clamp_fraction(spec, koff, level, values, weights)
+            offs = level_auto_offset(spec, level, koff, values, weights)
+            frac_ctr = clamp_fraction(spec, offs, level, values, weights)
+            return frac_now, offs, frac_ctr
+
+        self._guard_stats = jax.jit(_guard_stats)
+
+        def _collapse_and_center(astate, mask):
+            # Collapse, then recenter the collapsed streams onto their
+            # binned-mass median: ceil(key_offset / 2) alone leaves the
+            # halved occupancy off-center, and an off-center window
+            # keeps clamping (and keeps collapsing) on data a centered
+            # window would hold.
+            new = collapse_once(spec, astate, mask)
+            did = new.level > astate.level
+            offs = batched.data_center_offsets(spec, new.base)
+            base = batched.recenter(
+                spec, new.base,
+                jnp.where(did, offs, new.base.key_offset),
+            )
+            return AdaptiveState(base, new.level)
+
+        self._collapse_center = jax.jit(_collapse_and_center)
+        self._correct = jax.jit(functools.partial(correct_values, spec))
+        self._collapse_once = jax.jit(
+            functools.partial(collapse_once, spec)
+        )
+        self._collapse_to = jax.jit(functools.partial(collapse_to, spec))
+        self._align_merge = jax.jit(functools.partial(align_for_merge, spec))
+
+    # -- core API ----------------------------------------------------------
+    def add(self, values, weights=None) -> "AdaptiveDDSketch":
+        """Ingest ``values[n_streams, S]``; returns self for chaining.
+
+        Two collapse triggers guard the batch:
+
+        * **pre-ingest guard** -- the batch's predicted edge-clamp
+          fraction at the current level (streams that already hold
+          binned mass only; empty streams auto-center first).  Streams
+          over ``spec.collapse_threshold`` collapse BEFORE the scatter,
+          so predictable clamping never loses resolution -- the
+          UDDSketch no-loss behavior;
+        * **post-ingest counter trigger** -- growth of the
+          ``collapsed_mass_frac`` counters past the threshold (the
+          backstop for mass that clamped anyway, e.g. a fresh stream's
+          very first batch outrunning its level-0 window; such a
+          stream stabilizes within a collapse or two).
+
+        Collapsed streams' values premap to their level representatives
+        (one elementwise device op), then the stock engines ingest.
+        Padding (``weights <= 0``), NaN, and empty-batch semantics
+        match :meth:`BatchedDDSketch.add` exactly.  Raises ``SpecError``
+        when a trigger fires while ``SKETCHES_TPU_ADAPTIVE=0``.
+        """
+        varr = jnp.asarray(values)
+        self._preguard(varr, weights)
+        v = varr if not self._any_level else self._premap(self._level, varr)
+        self._inner.add(v, weights)
+        self._maybe_collapse()
+        return self
+
+    def _preguard(self, varr, weights) -> None:
+        """Pre-ingest collapse guard (see :meth:`add`).
+
+        Per over-threshold stream, the cheaper fix wins: if a window
+        RECENTER at the current level would fit the batch (the clamp is
+        a regime *shift*), the window slides -- no alpha loss; only
+        when even a centered window cannot hold the batch (the clamp is
+        *width*) does the stream collapse.  Raises ``SpecError`` when a
+        collapse is needed while the kill switch is 0 (recentering
+        alone stays allowed -- it is the dense tier's own mechanism).
+        """
+        st = self._inner.state
+        has_mass = (
+            np.asarray(jax.device_get(st.count - st.zero_count), np.float64)
+            > 0
+        )
+        thr = self.spec.collapse_threshold
+        for _ in range(self.spec.max_collapses + 2):
+            st = self._inner.state
+            frac_now_d, offs, frac_ctr_d = self._guard_stats(
+                st.key_offset, self._level, varr, weights
+            )
+            frac_now = np.asarray(jax.device_get(frac_now_d), np.float64)
+            frac_centered = np.asarray(jax.device_get(frac_ctr_d), np.float64)
+            level = np.asarray(jax.device_get(self._level))
+            # Empty streams judge against the window their first batch
+            # will auto-center (their current offset is provisional);
+            # occupied streams judge against the window they have.
+            relevant = np.where(has_mass, frac_now, frac_centered)
+            bad = relevant > thr
+            if not bad.any():
+                return
+            collapse_mask = (
+                bad & (frac_centered > thr)
+                & (level < self.spec.max_collapses)
+            )
+            recenter_mask = bad & has_mass & (frac_centered <= thr)
+            if collapse_mask.any():
+                if not registry.enabled(registry.ADAPTIVE):
+                    raise SpecError(
+                        "pre-ingest uniform collapse triggered on"
+                        f" streams {np.nonzero(collapse_mask)[0].tolist()[:8]}"
+                        " but SKETCHES_TPU_ADAPTIVE=0: refusing to"
+                        " degrade alpha (widen the window or re-enable"
+                        " the switch)"
+                    )
+                self._apply_collapse(np.asarray(collapse_mask))
+            elif recenter_mask.any():
+                self._inner.recenter(
+                    jnp.where(
+                        jnp.asarray(recenter_mask), offs, st.key_offset
+                    )
+                )
+            else:
+                return  # only at-cap streams remain: they clamp, counted
+
+    def _maybe_collapse(self) -> bool:
+        """Run the collapse trigger -> whether any stream collapsed.
+
+        A stream triggers when the growth of its edge-clamped mass
+        since the last collapse exceeds ``spec.collapse_threshold``
+        of its binned mass.  Raises ``SpecError`` when the trigger
+        fires while the ``SKETCHES_TPU_ADAPTIVE`` kill switch is 0
+        (refuse loudly: silent alpha degradation is exactly what the
+        switch exists to forbid).
+        """
+        st = self._inner.state
+        collapsed, binned, level = (
+            np.asarray(a, np.float64)
+            for a in jax.device_get(
+                (
+                    st.collapsed_low + st.collapsed_high,
+                    st.count - st.zero_count,
+                    self._level,
+                )
+            )
+        )
+        growth = collapsed - self._trigger_collapsed
+        mask = (growth > self.spec.collapse_threshold * np.maximum(binned, 1.0)) & (
+            level < self.spec.max_collapses
+        )
+        if not mask.any():
+            return False
+        if not registry.enabled(registry.ADAPTIVE):
+            raise SpecError(
+                "uniform collapse triggered on streams"
+                f" {np.nonzero(mask)[0].tolist()[:8]} but"
+                " SKETCHES_TPU_ADAPTIVE=0: refusing to degrade alpha"
+                " (raise the window, recenter, or re-enable the switch)"
+            )
+        self._apply_collapse(np.asarray(mask))
+        return True
+
+    def _apply_collapse(self, mask: np.ndarray) -> None:
+        astate = self._collapse_center(
+            AdaptiveState(self._inner.state, self._level), jnp.asarray(mask)
+        )
+        self._inner.state = astate.base  # setter: plans + policy reset
+        self._level = astate.level
+        self._any_level = True
+        st = self._inner.state
+        self._trigger_collapsed = np.asarray(
+            jax.device_get(st.collapsed_low + st.collapsed_high), np.float64
+        )
+        n = int(mask.sum())
+        if telemetry._ACTIVE:
+            telemetry.counter_inc("backend.collapses", float(n))
+            alpha = np.asarray(
+                jax.device_get(effective_alpha(self.spec, self._level))
+            )
+            for s in np.nonzero(mask)[0][:8]:
+                telemetry.gauge_set(
+                    "backend.effective_alpha", float(alpha[s]),
+                    stream=int(s),
+                )
+        if tracing._ACTIVE:
+            tracing.record_event(
+                "backend.collapse", n_streams=n, component="adaptive"
+            )
+
+    def collapse(self, mask=None) -> "AdaptiveDDSketch":
+        """Collapse the masked streams one level explicitly.
+
+        Same kill-switch contract as the automatic trigger: raises
+        ``SpecError`` when ``SKETCHES_TPU_ADAPTIVE=0``.  Streams at
+        ``spec.max_collapses`` are silently excluded (they can only
+        clamp).  Returns self.
+        """
+        if not registry.enabled(registry.ADAPTIVE):
+            raise SpecError(
+                "explicit collapse refused: SKETCHES_TPU_ADAPTIVE=0"
+            )
+        m = (
+            np.ones((self.n_streams,), bool)
+            if mask is None
+            else np.asarray(mask, bool)
+        )
+        self._apply_collapse(m)
+        return self
+
+    def get_quantile_value(self, q: float) -> jax.Array:
+        """Per-stream value at ``q`` -> ``[n_streams]`` (NaN if empty)."""
+        return self.get_quantile_values([q])[:, 0]
+
+    def get_quantile_values(self, quantiles: Sequence[float]) -> jax.Array:
+        """Level-corrected fused multi-quantile -> ``[n_streams, Q]``.
+
+        Within ``effective_alpha()`` of the true quantiles per stream
+        (the degraded-but-declared contract); NaN for empty streams or
+        out-of-range q; engine failures degrade down the wrapped
+        ladder exactly like the dense facade.
+        """
+        return self._correct(
+            self._level, self._inner.get_quantile_values(quantiles)
+        )
+
+    def get_quantile_values_resolved(
+        self, quantiles: Sequence[float], disabled_tiers: Sequence[str] = (),
+    ):
+        """:meth:`get_quantile_values` that also names the engine tier
+        -> ``(tier, [n_streams, Q])``; tier exclusions and failure
+        degradation ride the wrapped facade unchanged."""
+        tier, vals = self._inner.get_quantile_values_resolved(
+            quantiles, disabled_tiers=disabled_tiers
+        )
+        return tier, self._correct(self._level, vals)
+
+    def _query_choice(self, qs_tuple, extra_disabled=frozenset()):
+        """Serve-tier seam: the wrapped facade's resolved tier/fn (the
+        correction rides :meth:`get_quantile_values_resolved`; failures
+        degrade identically)."""
+        return self._inner._query_choice(qs_tuple, extra_disabled)
+
+    def merge(self, other: "AdaptiveDDSketch") -> "AdaptiveDDSketch":
+        """Fold ``other`` in, collapsing the finer operand first.
+
+        Mixed-gamma merge: per stream both operands collapse to the
+        pairwise max level, then the bases merge window-aligned with
+        the armed integrity layer fingerprinting the ALIGNED operands
+        (fingerprint-accounted; collapse legitimately changes content,
+        so accounting happens after alignment).  Raises
+        ``UnequalSketchParametersError`` on spec mismatch.
+        """
+        if not self.mergeable(other):
+            from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+            raise UnequalSketchParametersError(
+                "Cannot merge two adaptive sketches with different specs"
+            )
+        mine, theirs = self._align_merge(
+            AdaptiveState(self._inner.state, self._level),
+            AdaptiveState(other._inner.state, other._level),
+        )
+        target = mine.level
+        if not registry.enabled(registry.ADAPTIVE):
+            # Alignment is pure, so nothing has committed yet: refuse
+            # the merge loudly if it would have collapsed either side.
+            deepened = np.asarray(
+                jax.device_get(
+                    jnp.logical_or(
+                        target > self._level, target > other._level
+                    )
+                )
+            )
+            if deepened.any():
+                raise SpecError(
+                    "mixed-gamma merge needs a collapse on streams"
+                    f" {np.nonzero(deepened)[0].tolist()[:8]} but"
+                    " SKETCHES_TPU_ADAPTIVE=0: refusing to degrade"
+                    " alpha"
+                )
+        _ipre = (
+            integrity.premerge(self.spec, mine.base, theirs.base)
+            if integrity._ACTIVE
+            else None
+        )
+        self._inner.state = mine.base
+        self._inner._stream_op(
+            "merge_aligned", self._inner._merge_body, theirs.base
+        )
+        self._inner._invalidate_plans()
+        self._level = target
+        self._any_level = self._any_level or other._any_level or bool(
+            np.any(np.asarray(jax.device_get(target)) > 0)
+        )
+        if _ipre is not None:
+            integrity.postmerge(
+                self.spec, self._inner.state, _ipre, seam="adaptive.merge"
+            )
+        self._trigger_collapsed = np.asarray(
+            jax.device_get(
+                self._inner.state.collapsed_low
+                + self._inner.state.collapsed_high
+            ),
+            np.float64,
+        )
+        return self
+
+    def mergeable(self, other) -> bool:
+        return getattr(other, "spec", None) == self.spec
+
+    # -- observability -----------------------------------------------------
+    def effective_alpha(self) -> jax.Array:
+        """Per-stream realized relative-accuracy bound -> ``[n_streams]``
+        (``spec.relative_accuracy`` until a stream collapses; the
+        quantile error contract every answer satisfies)."""
+        return effective_alpha(self.spec, self._level)
+
+    def collapsed_fraction(self) -> jax.Array:
+        """Per-stream edge-clamped mass fraction (host sync; see
+        :meth:`BatchedDDSketch.collapsed_fraction`)."""
+        return self._inner.collapsed_fraction()
+
+    @property
+    def level(self) -> jax.Array:
+        return self._level
+
+    @property
+    def state(self) -> AdaptiveState:
+        return AdaptiveState(self._inner.state, self._level)
+
+    @state.setter
+    def state(self, new_state: AdaptiveState) -> None:
+        # External choke point (checkpoint restore): inner caches reset
+        # via the wrapped setter; the trigger re-baselines (comparing
+        # growth against another state's history would misfire).
+        self._inner.state = new_state.base
+        self._level = jnp.asarray(new_state.level, jnp.int32)
+        self._any_level = bool(
+            np.any(np.asarray(jax.device_get(self._level)) > 0)
+        )
+        self._trigger_collapsed = np.asarray(
+            jax.device_get(
+                new_state.base.collapsed_low + new_state.base.collapsed_high
+            ),
+            np.float64,
+        )
+
+    @property
+    def n_streams(self) -> int:
+        return self._inner.n_streams
+
+    @property
+    def count(self) -> jax.Array:
+        return self._inner.count
+
+    @property
+    def relative_accuracy(self) -> float:
+        return self.spec.relative_accuracy
+
+    def __repr__(self) -> str:
+        return (
+            f"AdaptiveDDSketch(n_streams={self.n_streams},"
+            f" n_bins={self.spec.n_bins},"
+            f" relative_accuracy={self.spec.relative_accuracy},"
+            f" threshold={self.spec.collapse_threshold})"
+        )

@@ -1,0 +1,401 @@
+"""Backend-tagged wire envelope: the ``SketchPayload`` message.
+
+The upstream DDSketch protobuf (``sketches_tpu.pb``) has no slot for a
+backend kind, a collapse level, or a moment vector -- and its first
+byte is always ``0x0a`` (field 1, the length-delimited ``mapping``
+submessage).  The envelope exploits that: a ``SketchPayload`` starts
+with field 1 as a *varint* (``0x08``), so the two formats are
+distinguishable from the first byte and plain dense blobs stay
+byte-identical to the classic path (full interop compatibility).
+
+Hand-rolled proto3 wire encoding, the ``pb/wire.py`` discipline::
+
+    message SketchPayload {
+      enum Backend { DENSE = 0; UNIFORM_COLLAPSE = 1; MOMENT = 2; }
+      Backend backend = 1;          // varint, always emitted
+      bytes   dense   = 2;          // classic DDSketch blob (dense/collapse)
+      uint32  level   = 3;          // uniform_collapse: stream's level
+      bytes   moment  = 4;          // MomentPayload submessage
+    }
+    message MomentPayload {
+      uint32 k        = 1;          // number of power sums per basis
+      // packed doubles: [count, zero_count, neg_count, sum, min, max]
+      repeated double scalars      = 2;
+      repeated double powers       = 3;  // k raw power sums
+      repeated double log_powers   = 4;  // k log power sums
+    }
+
+Forward compatibility is LOUD by design: a decoder that meets an
+unknown ``SketchPayload.Backend`` enum value raises
+:class:`~sketches_tpu.resilience.WireDecodeError` naming the enum and
+the value -- never a silent misdecode (the same contract
+``KeyMappingProto.from_proto`` carries for the ``Interpolation`` enum).
+
+Failure modes: truncated/garbled blobs, wrong wire types, a level
+outside ``[0, 64]``, a moment payload whose vector lengths disagree
+with its ``k``, and backend/spec mismatches all raise
+``WireDecodeError`` with the offending detail; encoding a state type
+that disagrees with ``spec.backend`` raises ``SpecError``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+
+from sketches_tpu.backends import BACKEND_ENUM, BACKEND_NAMES
+from sketches_tpu.resilience import SpecError, WireDecodeError
+
+__all__ = ["payload_to_bytes", "payload_from_bytes"]
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(blob: bytes, i: int) -> Tuple[int, int]:
+    shift = 0
+    out = 0
+    while True:
+        if i >= len(blob):
+            raise WireDecodeError(
+                "SketchPayload truncated inside a varint"
+            )
+        b = blob[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+        if shift > 63:
+            raise WireDecodeError("SketchPayload varint overflows 64 bits")
+
+
+def _field(tag: int, wire_type: int) -> bytes:
+    return _varint((tag << 3) | wire_type)
+
+
+def _ld(tag: int, payload: bytes) -> bytes:
+    return _field(tag, 2) + _varint(len(payload)) + payload
+
+
+def _packed_doubles(vals) -> bytes:
+    arr = np.ascontiguousarray(np.asarray(vals, np.float64))
+    return arr.tobytes()
+
+
+def _moment_payload(k: int, scalars, powers, log_powers) -> bytes:
+    return (
+        _field(1, 0)
+        + _varint(k)
+        + _ld(2, _packed_doubles(scalars))
+        + _ld(3, _packed_doubles(powers))
+        + _ld(4, _packed_doubles(log_powers))
+    )
+
+
+def payload_to_bytes(spec, state) -> List[bytes]:
+    """Serialize every stream of a backend state to envelope blobs.
+
+    ``spec.backend`` picks the layout: ``dense`` delegates to the
+    classic bulk encoder (byte-identical, NO envelope -- interop
+    preserved); ``uniform_collapse`` wraps each stream's dense blob
+    with its collapse level; ``moment`` emits the moment payload.
+    Raises ``SpecError`` when the state type disagrees with the spec's
+    backend (a moment state under a dense spec is a caller bug, not a
+    decode problem).
+    """
+    from sketches_tpu.pb.wire import state_to_bytes
+
+    backend = spec.backend
+    enum = BACKEND_ENUM[backend]
+    if backend == "dense":
+        if not hasattr(state, "bins_pos"):
+            raise SpecError(
+                "dense backend serialization needs a SketchState;"
+                f" got {type(state).__name__}"
+            )
+        return state_to_bytes(spec, state)
+    if backend == "uniform_collapse":
+        if not hasattr(state, "base") or not hasattr(state, "level"):
+            raise SpecError(
+                "uniform_collapse serialization needs an AdaptiveState;"
+                f" got {type(state).__name__}"
+            )
+        dense_blobs = state_to_bytes(spec, state.base)
+        levels = np.asarray(jax.device_get(state.level), np.int64)
+        head = _field(1, 0) + _varint(enum)
+        return [
+            head
+            + _ld(2, blob)
+            + _field(3, 0)
+            + _varint(int(levels[i]))
+            for i, blob in enumerate(dense_blobs)
+        ]
+    # moment
+    if not hasattr(state, "powers"):
+        raise SpecError(
+            "moment serialization needs a MomentState;"
+            f" got {type(state).__name__}"
+        )
+    host = jax.device_get(
+        (state.count, state.zero_count, state.neg_count, state.sum,
+         state.min, state.max, state.powers, state.log_powers)
+    )
+    count, zero, neg, total, vmin, vmax, powers, log_powers = (
+        np.asarray(x, np.float64) for x in host
+    )
+    k = powers.shape[-1]
+    head = _field(1, 0) + _varint(enum)
+    return [
+        head
+        + _ld(
+            4,
+            _moment_payload(
+                k,
+                [count[i], zero[i], neg[i], total[i], vmin[i], vmax[i]],
+                powers[i],
+                log_powers[i],
+            ),
+        )
+        for i in range(count.shape[0])
+    ]
+
+
+def _skip_field(blob: bytes, i: int, wire_type: int) -> int:
+    if wire_type == 0:
+        _, i = _read_varint(blob, i)
+        return i
+    if wire_type == 1:
+        return i + 8
+    if wire_type == 2:
+        n, i = _read_varint(blob, i)
+        return i + n
+    if wire_type == 5:
+        return i + 4
+    raise WireDecodeError(
+        f"SketchPayload wire type {wire_type} unsupported"
+    )
+
+
+def _parse_payload(blob: bytes):
+    """One envelope blob -> ``(backend_enum, dense, level, moment)``.
+
+    Unknown fields skip (proto3 semantics); an unknown *backend enum*
+    refuses loudly by name; structural damage raises
+    ``WireDecodeError``.
+    """
+    i = 0
+    backend = 0
+    dense = None
+    level = 0
+    moment = None
+    n_total = len(blob)
+    while i < n_total:
+        key, i = _read_varint(blob, i)
+        tag, wt = key >> 3, key & 7
+        if tag == 1 and wt == 0:
+            backend, i = _read_varint(blob, i)
+        elif tag == 2 and wt == 2:
+            n, i = _read_varint(blob, i)
+            if i + n > n_total:
+                raise WireDecodeError(
+                    "SketchPayload.dense truncated"
+                )
+            dense = blob[i : i + n]
+            i += n
+        elif tag == 3 and wt == 0:
+            level, i = _read_varint(blob, i)
+        elif tag == 4 and wt == 2:
+            n, i = _read_varint(blob, i)
+            if i + n > n_total:
+                raise WireDecodeError(
+                    "SketchPayload.moment truncated"
+                )
+            moment = blob[i : i + n]
+            i += n
+        else:
+            i = _skip_field(blob, i, wt)
+        if i > n_total:
+            raise WireDecodeError("SketchPayload truncated mid-field")
+    if backend not in BACKEND_NAMES:
+        raise WireDecodeError(
+            f"unknown SketchPayload.Backend enum value {backend}:"
+            " refusing to decode (emitter is newer than this reader;"
+            f" known values {sorted(BACKEND_NAMES)})"
+        )
+    return backend, dense, level, moment
+
+
+def _parse_moment(payload: bytes):
+    """MomentPayload bytes -> ``(k, scalars[6], powers[k], log_powers[k])``;
+    length/structure damage raises ``WireDecodeError``."""
+    i = 0
+    k = None
+    scalars = powers = log_powers = None
+    n_total = len(payload)
+    while i < n_total:
+        key, i = _read_varint(payload, i)
+        tag, wt = key >> 3, key & 7
+        if tag == 1 and wt == 0:
+            k, i = _read_varint(payload, i)
+        elif tag in (2, 3, 4) and wt == 2:
+            n, i = _read_varint(payload, i)
+            if i + n > n_total or n % 8:
+                raise WireDecodeError(
+                    "MomentPayload packed-double run truncated"
+                )
+            arr = np.frombuffer(payload[i : i + n], np.float64)
+            if tag == 2:
+                scalars = arr
+            elif tag == 3:
+                powers = arr
+            else:
+                log_powers = arr
+            i += n
+        else:
+            i = _skip_field(payload, i, wt)
+    if k is None or scalars is None or powers is None or log_powers is None:
+        raise WireDecodeError(
+            "MomentPayload missing required fields (k/scalars/powers/"
+            "log_powers)"
+        )
+    if scalars.shape[0] != 6 or powers.shape[0] != k \
+            or log_powers.shape[0] != k:
+        raise WireDecodeError(
+            f"MomentPayload vector lengths disagree with k={k}:"
+            f" scalars={scalars.shape[0]}, powers={powers.shape[0]},"
+            f" log_powers={log_powers.shape[0]}"
+        )
+    return k, scalars, powers, log_powers
+
+
+def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
+    """Decode envelope (or plain dense) blobs into one backend state.
+
+    Returns a :class:`SketchState` (dense spec), ``AdaptiveState``
+    (uniform_collapse spec), or ``MomentState`` (moment spec).  Plain
+    dense blobs (first byte ``0x0a``) decode through the classic bulk
+    path under a dense spec.  Raises ``WireDecodeError`` for: a blob
+    whose backend tag disagrees with ``spec.backend``, an unknown
+    backend enum value (named loudly), structural damage, a level
+    outside ``[0, spec.max_collapses]``... every refusal names the
+    stream index; an empty ``blobs`` list decodes to an empty state.
+    """
+    import jax.numpy as jnp
+
+    from sketches_tpu.backends import BACKEND_ENUM as ENUM
+
+    want = spec.backend
+    if want == "dense":
+        from sketches_tpu.pb.wire import bytes_to_state
+
+        for idx, blob in enumerate(blobs):
+            if blob[:1] == b"\x08":
+                raise WireDecodeError(
+                    f"blob {idx} is a SketchPayload envelope but the"
+                    " spec's backend is 'dense': decode it with the"
+                    " matching backend spec"
+                )
+        return bytes_to_state(
+            spec, blobs, assume_native_linear=assume_native_linear
+        )
+    if want == "uniform_collapse":
+        from sketches_tpu.pb.wire import bytes_to_state
+
+        dense_blobs: List[bytes] = []
+        levels: List[int] = []
+        for idx, blob in enumerate(blobs):
+            backend, dense, level, _ = _parse_payload(bytes(blob))
+            if backend != ENUM[want]:
+                raise WireDecodeError(
+                    f"blob {idx} carries backend"
+                    f" {BACKEND_NAMES.get(backend, backend)!r}, spec"
+                    f" wants {want!r}"
+                )
+            if dense is None:
+                raise WireDecodeError(
+                    f"blob {idx}: uniform_collapse envelope missing the"
+                    " dense payload"
+                )
+            if not 0 <= level <= spec.max_collapses:
+                raise WireDecodeError(
+                    f"blob {idx}: collapse level {level} outside"
+                    f" [0, {spec.max_collapses}]"
+                )
+            dense_blobs.append(dense)
+            levels.append(level)
+        from sketches_tpu.backends.uniform import AdaptiveState
+
+        base = bytes_to_state(
+            spec, dense_blobs, assume_native_linear=assume_native_linear
+        )
+        return AdaptiveState(
+            base=base, level=jnp.asarray(levels, jnp.int32)
+        )
+    # moment
+    from sketches_tpu.backends.moment import MomentState
+
+    n = len(blobs)
+    k_spec = spec.n_moments
+    count = np.zeros((n,), np.float64)
+    zero = np.zeros((n,), np.float64)
+    neg = np.zeros((n,), np.float64)
+    total = np.zeros((n,), np.float64)
+    vmin = np.full((n,), np.inf, np.float64)
+    vmax = np.full((n,), -np.inf, np.float64)
+    powers = np.zeros((n, k_spec), np.float64)
+    log_powers = np.zeros((n, k_spec), np.float64)
+    for idx, blob in enumerate(blobs):
+        backend, _, _, moment = _parse_payload(bytes(blob))
+        if backend != ENUM[want]:
+            raise WireDecodeError(
+                f"blob {idx} carries backend"
+                f" {BACKEND_NAMES.get(backend, backend)!r}, spec wants"
+                f" {want!r}"
+            )
+        if moment is None:
+            raise WireDecodeError(
+                f"blob {idx}: moment envelope missing the moment payload"
+            )
+        k, scalars, p, lp = _parse_moment(moment)
+        if k != k_spec:
+            raise WireDecodeError(
+                f"blob {idx}: moment payload has k={k}, spec wants"
+                f" k={k_spec}"
+            )
+        count[idx], zero[idx], neg[idx], total[idx], vmin[idx], vmax[idx] = (
+            scalars
+        )
+        powers[idx] = p
+        log_powers[idx] = lp
+    dt = np.dtype(jnp.dtype(spec.dtype).name)
+
+    def cast(a):
+        # Saturated power sums round-trip as +/-inf in the narrower
+        # device dtype -- the moment backend's documented saturation
+        # state, not an error.
+        with np.errstate(over="ignore"):
+            return jnp.asarray(a.astype(dt))
+    return MomentState(
+        count=cast(count),
+        zero_count=cast(zero),
+        neg_count=cast(neg),
+        sum=cast(total),
+        min=cast(vmin),
+        max=cast(vmax),
+        powers=cast(powers),
+        log_powers=cast(log_powers),
+    )
+

@@ -131,12 +131,52 @@ class SketchSpec:
     # the integer state); weighted calls and all queries take the XLA
     # path, whose integer scatter/cumsum/rank-select never rounds.
     bin_dtype: Optional[jnp.dtype] = None
+    # Accuracy/memory backend contract (``sketches_tpu.backends``):
+    # ``"dense"`` is the classic dense-bin store above;
+    # ``"uniform_collapse"`` is the UDDSketch-style adaptive store (same
+    # dense state + a per-stream collapse level -- alpha degrades
+    # gamma -> gamma**2 per collapse instead of mass corrupting the
+    # window edges; logarithmic mapping only); ``"moment"`` is the
+    # compact moment summary (~n_moments power sums per stream, no bins).
+    backend: str = "dense"
+    # Uniform-collapse trigger: a stream whose edge-clamped mass fraction
+    # (collapsed_low+high over binned mass) crosses this collapses once.
+    collapse_threshold: float = 0.01
+    # Uniform-collapse level cap: gamma_eff = gamma**(2**level) -- 10
+    # doublings at alpha=0.01 already put alpha_eff past 0.99, so deeper
+    # levels only lose information.  Hitting the cap stops collapsing
+    # (mass then clamps at the edges again, counted as usual).
+    max_collapses: int = 10
+    # Moment backend: number of power sums kept per stream (per basis).
+    n_moments: int = 12
 
     def __post_init__(self):
         if not 0.0 < self.relative_accuracy < 1.0:
             raise SpecError("Relative accuracy must be between 0 and 1.")
         if self.n_bins < 2:
             raise SpecError("n_bins must be >= 2")
+        if self.backend not in ("dense", "uniform_collapse", "moment"):
+            raise SpecError(
+                f"Unknown backend {self.backend!r}: expected one of"
+                " 'dense', 'uniform_collapse', 'moment'"
+            )
+        if self.backend == "uniform_collapse":
+            if self.mapping_name != "logarithmic":
+                raise SpecError(
+                    "uniform_collapse backend requires the logarithmic"
+                    " mapping (gamma -> gamma**2 collapse algebra only"
+                    " composes on exact log keys); got"
+                    f" {self.mapping_name!r}"
+                )
+            if not 0.0 < self.collapse_threshold < 1.0:
+                raise SpecError("collapse_threshold must be in (0, 1)")
+            if self.max_collapses < 1:
+                raise SpecError("max_collapses must be >= 1")
+        if self.backend == "moment" and not 2 <= self.n_moments <= 16:
+            raise SpecError(
+                "n_moments must be in [2, 16] (f32 power sums past 16"
+                " carry no usable signal)"
+            )
         if self.key_offset is None:
             object.__setattr__(self, "key_offset", -(self.n_bins // 2))
         if self.bin_dtype is None:
@@ -184,6 +224,10 @@ class SketchSpec:
                 self.key_offset,
                 jnp.dtype(self.dtype).name,
                 jnp.dtype(self.bin_dtype).name,
+                self.backend,
+                self.collapse_threshold,
+                self.max_collapses,
+                self.n_moments,
             )
         )
 

@@ -81,6 +81,7 @@ __all__ = [
     "run_campaign",
     "run_serve_campaign",
     "run_elastic_campaign",
+    "run_adaptive_campaign",
     "main",
 ]
 
@@ -1050,6 +1051,404 @@ def run_elastic_campaign(
             own_tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# Adaptive campaign (the accuracy-backend soak)
+# ---------------------------------------------------------------------------
+
+#: Adaptive-campaign shape: few streams, narrow windows (so regime
+#: drift genuinely forces collapses), bounded per-stream value logs for
+#: the alpha-contract audit.
+_AD_STREAMS = 8
+_AD_BINS = 128
+_AD_BATCH = 32
+_AD_THRESHOLD = 0.05
+_AD_QS = (0.25, 0.5, 0.9)
+
+
+def _ad_quantile_audit(c, step: int) -> None:
+    """The alpha-contract audit: the adaptive facade's answers must sit
+    within the *effective* alpha of the exact quantiles of every value
+    it ever ingested (widened by the edge-clamped fraction -- clamped
+    mass legitimately carries phantom ranks; raises ``SketchError`` on
+    a breach)."""
+    sk = c["adaptive"]
+    q = np.asarray(sk.get_quantile_values(list(_AD_QS)), np.float64)
+    ea = np.asarray(sk.effective_alpha(), np.float64)
+    cf = np.asarray(sk.collapsed_fraction(), np.float64)
+    for s, vals in enumerate(c["values"]):
+        if len(vals) < 8:
+            continue
+        arr = np.asarray(vals, np.float64)
+        # Clamped mass shifts ranks by up to its fraction: audit the
+        # quantile against the exact-rank bracket widened by that shift,
+        # then by the effective alpha.
+        for qi, qq in enumerate(_AD_QS):
+            got = float(q[s, qi])
+            lo_r = max(0.0, qq - cf[s] - 0.02)
+            hi_r = min(1.0, qq + cf[s] + 0.02)
+            lo_v = float(np.quantile(arr, lo_r, method="lower"))
+            hi_v = float(np.quantile(arr, hi_r, method="higher"))
+            lo_b = lo_v - ea[s] * abs(lo_v) - 1e-6
+            hi_b = hi_v + ea[s] * abs(hi_v) + 1e-6
+            if not lo_b <= got <= hi_b:
+                raise SketchError(
+                    f"alpha contract breach: stream {s} q{qq} = {got:g}"
+                    f" outside [{lo_b:g}, {hi_b:g}] at effective alpha"
+                    f" {ea[s]:.4f} (collapsed frac {cf[s]:.4f})"
+                )
+
+
+def _ad_expected_counts(c) -> float:
+    return float(
+        sum(len(v) for v in c["values"]) + c["moment_count"]
+    )
+
+
+def _ad_actual_counts(c) -> float:
+    return float(
+        np.asarray(c["adaptive"].count, np.float64).sum()
+        + np.asarray(c["moment"].count, np.float64).sum()
+    )
+
+
+def run_adaptive_campaign(
+    steps: int, seed: int, tmpdir: Optional[str] = None
+) -> Dict[str, Any]:
+    """Run the seeded adaptive-backend chaos campaign -> the verdict.
+
+    One uniform-collapse facade rides a regime-drifting workload
+    (location drift + seeded scale explosions force collapses
+    MID-INGEST) next to one moment facade, with the integrity layer
+    armed.  Every step the campaign may: ingest, audit the alpha
+    contract at the *effective* alpha (exact-value ledger), merge a
+    mixed-gamma operand (count conserved exactly), round-trip the
+    backend wire envelope, or checkpoint/restore -- and with
+    probability the armed fault sites corrupt the wire blobs, flip
+    state bits, tear checkpoint writes, or flip the
+    ``SKETCHES_TPU_ADAPTIVE`` kill switch under a firing trigger
+    (which must refuse loudly).  ``ok`` iff every injected fault is
+    detected or provably harmless, mass is EXACTLY conserved, and the
+    alpha audit never breaches.  Raises ``SketchValueError`` for
+    non-positive ``steps``; campaign-level failures are reported, not
+    raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    import os as _os
+
+    from sketches_tpu.backends.moment import MomentDDSketch
+    from sketches_tpu.backends.uniform import AdaptiveDDSketch
+    from sketches_tpu.backends.wirefmt import (
+        payload_from_bytes,
+        payload_to_bytes,
+    )
+    from sketches_tpu.batched import SketchSpec
+    from sketches_tpu.resilience import SpecError, WireDecodeError
+
+    was_active, was_mode = integrity.enabled(), integrity.mode()
+    faults.disarm()
+    integrity.arm("quarantine")
+    own_tmp = None
+    if tmpdir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="sketches_adaptive_")
+        tmpdir = own_tmp.name
+    rng = np.random.default_rng(seed)
+    aspec = SketchSpec(
+        relative_accuracy=_REL_ACC, n_bins=_AD_BINS,
+        backend="uniform_collapse", collapse_threshold=_AD_THRESHOLD,
+    )
+    mspec = SketchSpec(
+        relative_accuracy=_REL_ACC, backend="moment", n_moments=8
+    )
+    c: Dict[str, Any] = {
+        "adaptive": AdaptiveDDSketch(_AD_STREAMS, spec=aspec),
+        "moment": MomentDDSketch(_AD_STREAMS, spec=mspec),
+        "values": [[] for _ in range(_AD_STREAMS)],
+        "moment_count": 0.0,
+        "drift": 0.0,
+        "scale": 0.6,
+    }
+    events: List[Dict[str, Any]] = []
+    errors: List[str] = []
+
+    def _ingest(step: int) -> None:
+        # Regime drift: the location random-walks; seeded scale
+        # explosions (~6% of steps) force mid-ingest collapses.
+        c["drift"] += float(rng.normal(0.0, 0.25))
+        if rng.random() < 0.06:
+            c["scale"] = min(c["scale"] * 2.5, 8.0)
+        vals = rng.lognormal(
+            c["drift"], c["scale"], (_AD_STREAMS, _AD_BATCH)
+        ).astype(np.float32)
+        c["adaptive"].add(vals)
+        c["moment"].add(vals)
+        c["moment_count"] += vals.size
+        for s in range(_AD_STREAMS):
+            c["values"][s].extend(float(x) for x in vals[s])
+
+    def _merge_mixed(step: int) -> None:
+        # A fresh operand at a DIFFERENT gamma (explicitly collapsed
+        # once) merges in: the mixed-gamma path, count conserved
+        # exactly.
+        other = AdaptiveDDSketch(_AD_STREAMS, spec=aspec)
+        vals = rng.lognormal(
+            c["drift"], 0.6, (_AD_STREAMS, _AD_BATCH)
+        ).astype(np.float32)
+        other.add(vals)
+        other.collapse()
+        before = np.asarray(c["adaptive"].count, np.float64).sum()
+        c["adaptive"].merge(other)
+        after = np.asarray(c["adaptive"].count, np.float64).sum()
+        if after != before + vals.size:
+            raise SketchError(
+                f"mixed-gamma merge lost mass: {after:g} !="
+                f" {before + vals.size:g}"
+            )
+        for s in range(_AD_STREAMS):
+            c["values"][s].extend(float(x) for x in vals[s])
+
+    def _wire_roundtrip(step: int) -> None:
+        for spec, facade in ((aspec, c["adaptive"]), (mspec, c["moment"])):
+            blobs = payload_to_bytes(spec, facade.state)
+            st2 = payload_from_bytes(spec, blobs)
+            got = float(np.asarray(st2.count, np.float64).sum())
+            want = float(np.asarray(facade.count, np.float64).sum())
+            if abs(got - want) > 0.5:
+                raise SketchError(
+                    f"{spec.backend} wire round trip lost mass:"
+                    f" {got:g} != {want:g}"
+                )
+
+    def _checkpoint_roundtrip(step: int) -> None:
+        from sketches_tpu import checkpoint
+
+        for name in ("adaptive", "moment"):
+            path = _os.path.join(tmpdir, f"{name}.ckpt")
+            checkpoint.save(path, c[name])
+            restored = checkpoint.restore(path)
+            got = float(np.asarray(restored.count, np.float64).sum())
+            want = float(np.asarray(c[name].count, np.float64).sum())
+            if abs(got - want) > 0.5:
+                raise SketchError(
+                    f"{name} checkpoint round trip lost mass"
+                )
+
+    def _fault_wire(step: int) -> str:
+        spec, facade = (
+            (aspec, c["adaptive"]) if step % 2 else (mspec, c["moment"])
+        )
+        blobs = payload_to_bytes(spec, facade.state)
+        idx = int(rng.integers(len(blobs)))
+        blob = bytearray(blobs[idx])
+        if not blob:
+            return "skipped"
+        pos = int(rng.integers(len(blob)))
+        blob[pos] ^= 1 << int(rng.integers(8))
+        corrupted = list(blobs)
+        corrupted[idx] = bytes(blob)
+        try:
+            st2 = payload_from_bytes(spec, corrupted)
+        except WireDecodeError:
+            return "detected"  # structural damage refused loudly
+        except Exception:  # noqa: BLE001 - any loud failure is detection
+            return "detected"
+        got = float(np.asarray(st2.count, np.float64).sum())
+        want = float(np.asarray(facade.count, np.float64).sum())
+        if abs(got - want) <= 0.5:
+            fp_a = integrity.fingerprint(spec, st2)
+            fp_b = integrity.fingerprint(spec, facade.state)
+            fin = np.isfinite(fp_a) & np.isfinite(fp_b)
+            if np.allclose(fp_a[fin], fp_b[fin], rtol=1e-6, atol=1e-3):
+                return "harmless"  # flipped a byte the format ignores
+        return "detected" if _ad_fp_differs(spec, facade, st2) else \
+            "undetected"
+
+    def _ad_fp_differs(spec, facade, st2) -> bool:
+        # Content changed: the fingerprint lane must notice (that IS
+        # the detection -- a serve cache keyed on it would miss, never
+        # serve the corrupted answer as the original).
+        fp_a = integrity.fingerprint(spec, st2)
+        fp_b = integrity.fingerprint(spec, facade.state)
+        fin = np.isfinite(fp_a) & np.isfinite(fp_b)
+        return not np.allclose(
+            fp_a[fin], fp_b[fin], rtol=1e-6, atol=1e-3
+        ) or bool((~fin).any())
+
+    def _fault_bitflip(step: int) -> str:
+        sk = c["adaptive"]
+        pre = sk.state
+        fp_pre = integrity.fingerprint(aspec, pre)
+        with faults.active(
+            {faults.STATE_BITFLIP: dict(seed=step, times=1)}
+        ):
+            flips = faults.state_bitflips(_AD_STREAMS, _AD_BINS)
+        if not flips:
+            return "skipped"
+        from sketches_tpu.backends.uniform import AdaptiveState
+
+        corrupted = AdaptiveState(
+            faults.apply_state_bitflips(pre.base, flips), pre.level
+        )
+        report = integrity.verify_state(
+            aspec, corrupted, seam="chaos.adaptive.bitflip",
+            errors="quarantine",
+        )
+        if report:
+            return "detected"
+        fp_post = integrity.fingerprint(aspec, corrupted)
+        if not np.allclose(fp_post, fp_pre, rtol=1e-6, atol=1e-3):
+            return "detected"  # the fingerprint lane
+        q_pre = np.asarray(sk.get_quantile_values(list(_AD_QS)))
+        sk.state = corrupted
+        q_post = np.asarray(sk.get_quantile_values(list(_AD_QS)))
+        sk.state = pre
+        same = np.allclose(
+            q_post, q_pre, rtol=4 * _REL_ACC, atol=1e-6, equal_nan=True
+        )
+        return "harmless" if same else "undetected"
+
+    def _fault_ckpt(step: int) -> str:
+        from sketches_tpu import checkpoint
+        from sketches_tpu.resilience import CheckpointCorrupt
+
+        path = _os.path.join(tmpdir, "torn_adaptive.ckpt")
+        checkpoint.save(path, c["adaptive"])  # good previous file
+        mode = "truncate" if step % 2 else "raise"
+        with faults.active(
+            {faults.CHECKPOINT_WRITE: dict(mode=mode, times=1)}
+        ):
+            try:
+                checkpoint.save(path, c["adaptive"])
+                crashed = False
+            except InjectedFault:
+                crashed = True
+        if crashed:
+            checkpoint.restore(path)  # previous file must survive
+            return "detected"
+        try:
+            checkpoint.restore(path)
+        except CheckpointCorrupt:
+            return "detected"
+        return "undetected"
+
+    def _fault_kill_switch(step: int) -> str:
+        # Arm a collapse-worthy batch under SKETCHES_TPU_ADAPTIVE=0:
+        # the trigger must refuse LOUDLY (SpecError), and the refused
+        # ingest must leave the facade's mass unchanged.
+        sk = c["adaptive"]
+        wide = rng.lognormal(
+            c["drift"], 8.0, (_AD_STREAMS, _AD_BATCH)
+        ).astype(np.float32)
+        before = float(np.asarray(sk.count, np.float64).sum())
+        from sketches_tpu.analysis import registry as _registry
+
+        _switch = _registry.ADAPTIVE.name
+        prior = _os.environ.get(_switch)
+        _os.environ[_switch] = "0"
+        try:
+            try:
+                sk.add(wide)
+            except SpecError:
+                after = float(np.asarray(sk.count, np.float64).sum())
+                return "detected" if after == before else "undetected"
+            # No collapse was needed for this batch: the switch had
+            # nothing to refuse -- ingest went through legitimately.
+            for s in range(_AD_STREAMS):
+                c["values"][s].extend(float(x) for x in wide[s])
+            return "harmless"
+        finally:
+            if prior is None:
+                _os.environ.pop(_switch, None)
+            else:
+                _os.environ[_switch] = prior
+
+    def _audit(step: int) -> None:
+        _ad_quantile_audit(c, step)
+
+    ops = (
+        (_ingest, 0.45),
+        (_audit, 0.2),
+        (_merge_mixed, 0.15),
+        (_wire_roundtrip, 0.1),
+        (_checkpoint_roundtrip, 0.1),
+    )
+    op_fns = [o[0] for o in ops]
+    op_ps = np.asarray([o[1] for o in ops])
+    op_ps = op_ps / op_ps.sum()
+    fault_sites = {
+        "wire.blob": _fault_wire,
+        "state.bitflip": _fault_bitflip,
+        "checkpoint.write": _fault_ckpt,
+        "adaptive.kill_switch": _fault_kill_switch,
+    }
+    site_names = tuple(fault_sites)
+    try:
+        for step in range(steps):
+            op = int(rng.choice(len(op_fns), p=op_ps))
+            try:
+                op_fns[op](step)
+            except Exception as e:  # un-faulted op must not fail
+                errors.append(f"step {step} op {op}: {e!r}")
+                break
+            if rng.random() < _FAULT_P:
+                site = site_names[int(rng.integers(len(site_names)))]
+                try:
+                    outcome = fault_sites[site](step)
+                except Exception as e:
+                    outcome = "undetected"
+                    errors.append(f"step {step} site {site}: {e!r}")
+                if outcome != "skipped":
+                    events.append(
+                        {"step": step, "site": site, "outcome": outcome}
+                    )
+                    _classify_forensics(site, outcome, step)
+        expected = _ad_expected_counts(c)
+        actual = _ad_actual_counts(c)
+        conserved = actual == expected  # EXACT: integer-valued ledger
+        if not conserved:
+            errors.append(
+                f"mass ledger broke: actual {actual:g} != expected"
+                f" {expected:g}"
+            )
+        outcomes: Dict[str, int] = {}
+        for ev in events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        ok = (
+            conserved and not errors
+            and outcomes.get("undetected", 0) == 0
+        )
+        return {
+            "campaign": "adaptive",
+            "steps": steps,
+            "seed": seed,
+            "ok": ok,
+            "n_faults": len(events),
+            "outcomes": outcomes,
+            "events": events,
+            "errors": errors,
+            "expected_count": expected,
+            "final_count": actual,
+            "final_levels": np.asarray(
+                c["adaptive"].level
+            ).tolist(),
+            "final_effective_alpha": np.asarray(
+                c["adaptive"].effective_alpha(), np.float64
+            ).round(5).tolist(),
+            "integrity_reports": len(integrity.reports()),
+            "health": resilience.health(),
+            "telemetry": telemetry.snapshot() if telemetry.enabled()
+            else None,
+        }
+    finally:
+        faults.disarm()
+        if was_active:
+            integrity.arm(was_mode)
+        else:
+            integrity.disarm()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry point: run the campaign, write the verdict, exit 0 iff
     every injected fault was accounted for (1 otherwise).
@@ -1069,13 +1468,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--campaign", choices=("core", "serve", "elastic"), default="core",
+        "--campaign", choices=("core", "serve", "elastic", "adaptive"),
+        default="core",
         help="core: the integrity soak over the storage/engine sites;"
         " serve: the serving-tier soak over the serve.* sites (every"
         " fault shed, hedged, or detected); elastic: the kill-and-regrow"
         " soak over the mesh.shard/mesh.host_loss/dcn.partition/"
         "reshard.torn sites across 1/2/4/8-device meshes (every fault"
-        " detected or recovered with exact mass accounting)",
+        " detected or recovered with exact mass accounting); adaptive:"
+        " the accuracy-backend soak (collapse mid-ingest, mixed-gamma"
+        " merges, backend wire round-trips under injected corruption,"
+        " kill-switch refusal -- alpha contract audited at the"
+        " effective alpha, mass ledger exact)",
     )
     parser.add_argument(
         "--mode", choices=("raise", "quarantine"), default="raise",
@@ -1104,6 +1508,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdict = run_serve_campaign(args.steps, args.seed)
     elif args.campaign == "elastic":
         verdict = run_elastic_campaign(args.steps, args.seed, mode=args.mode)
+    elif args.campaign == "adaptive":
+        verdict = run_adaptive_campaign(args.steps, args.seed)
     else:
         verdict = run_campaign(args.steps, args.seed, mode=args.mode)
     if args.out:

@@ -45,6 +45,66 @@ __all__ = ["save", "restore", "restore_distributed", "save_state", "restore_stat
 
 _FIELDS = [f.name for f in dataclasses.fields(SketchState)]
 
+#: Moment-backend state fields (``backends.moment.MomentState``);
+#: imported lazily at save/restore so the checkpoint module stays
+#: light for dense-only users.
+_MOMENT_FIELDS = [
+    "count", "zero_count", "neg_count", "sum", "min", "max", "powers",
+    "log_powers",
+]
+
+
+def _state_arrays(spec: SketchSpec, state) -> dict:
+    """Flatten any backend state to the npz array dict (the save-side
+    twin of :func:`_arrays_to_backend_state`); raises ``SpecError``
+    when the state type disagrees with ``spec.backend``."""
+    from sketches_tpu.resilience import SpecError
+
+    if spec.backend == "uniform_collapse":
+        if not hasattr(state, "base"):
+            raise SpecError(
+                "uniform_collapse checkpoint needs an AdaptiveState;"
+                f" got {type(state).__name__}"
+            )
+        arrays = {
+            name: np.asarray(jax.device_get(getattr(state.base, name)))
+            for name in _FIELDS
+        }
+        arrays["level"] = np.asarray(jax.device_get(state.level))
+        return arrays
+    if spec.backend == "moment":
+        if not hasattr(state, "powers"):
+            raise SpecError(
+                "moment checkpoint needs a MomentState;"
+                f" got {type(state).__name__}"
+            )
+        return {
+            name: np.asarray(jax.device_get(getattr(state, name)))
+            for name in _MOMENT_FIELDS
+        }
+    return {
+        name: np.asarray(jax.device_get(getattr(state, name)))
+        for name in _FIELDS
+    }
+
+
+def _arrays_to_backend_state(spec: SketchSpec, arrays: dict):
+    """npz arrays -> the spec's backend state type (restore-side twin
+    of :func:`_state_arrays`); a missing backend-specific member raises
+    through the caller's ``CheckpointCorrupt`` wrapper."""
+    if spec.backend == "uniform_collapse":
+        from sketches_tpu.backends.uniform import AdaptiveState
+
+        level = arrays.pop("level")
+        return AdaptiveState(
+            base=SketchState(**arrays), level=jnp.asarray(level, jnp.int32)
+        )
+    if spec.backend == "moment":
+        from sketches_tpu.backends.moment import MomentState
+
+        return MomentState(**arrays)
+    return SketchState(**arrays)
+
 
 def _digest(spec_json: str, arrays: dict) -> str:
     """Content checksum over the spec + every array's identity and bytes."""
@@ -67,8 +127,7 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
         # Guarded seam: refuse to persist an already-corrupted state
         # (raise/quarantine per the armed mode).
         integrity.verify_state(spec, state, seam="checkpoint.save")
-    arrays = {name: np.asarray(jax.device_get(getattr(state, name)))
-              for name in _FIELDS}
+    arrays = _state_arrays(spec, state)
     spec_json = json.dumps(
         {
             "relative_accuracy": spec.relative_accuracy,
@@ -77,6 +136,10 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
             "key_offset": spec.key_offset,
             "dtype": jnp.dtype(spec.dtype).name,
             "bin_dtype": jnp.dtype(spec.bin_dtype).name,
+            "backend": spec.backend,
+            "collapse_threshold": spec.collapse_threshold,
+            "max_collapses": spec.max_collapses,
+            "n_moments": spec.n_moments,
         }
     )
     # Serialize to memory first: the bytes hit disk in one write, so the
@@ -88,11 +151,10 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
     if integrity._ACTIVE:
         # Per-stream content fingerprint rides along so an armed restore
         # can verify the state across the save->restore boundary even on
-        # pre-checksum readers (sha256 covers bytes; this covers content).
-        extra["__fingerprint__"] = integrity._fingerprint_arrays(
-            arrays["bins_pos"], arrays["bins_neg"], arrays["zero_count"],
-            arrays["key_offset"],
-        )
+        # pre-checksum readers (sha256 covers bytes; this covers
+        # content).  ``integrity.fingerprint`` dispatches per backend
+        # state type (dense / adaptive / moment).
+        extra["__fingerprint__"] = integrity.fingerprint(spec, state)
     buf = io.BytesIO()
     np.savez_compressed(
         buf,
@@ -162,11 +224,31 @@ def _restore_state_inner(path: str):
         )
         meta_json = bytes(data["__spec__"]).decode()
         meta = json.loads(meta_json)
+        spec = SketchSpec(
+            relative_accuracy=meta["relative_accuracy"],
+            mapping_name=meta["mapping_name"],
+            n_bins=meta["n_bins"],
+            key_offset=meta["key_offset"],
+            dtype=jnp.dtype(meta["dtype"]),
+            # Pre-r3 checkpoints carry no bin_dtype: bins followed dtype.
+            bin_dtype=jnp.dtype(meta.get("bin_dtype", meta["dtype"])),
+            # Pre-r15 checkpoints carry no backend: every state was dense.
+            backend=meta.get("backend", "dense"),
+            collapse_threshold=meta.get("collapse_threshold", 0.01),
+            max_collapses=meta.get("max_collapses", 10),
+            n_moments=meta.get("n_moments", 12),
+        )
+        if spec.backend == "moment":
+            fields = list(_MOMENT_FIELDS)
+        elif spec.backend == "uniform_collapse":
+            fields = _FIELDS + ["level"]
+        else:
+            fields = list(_FIELDS)
         if "__checksum__" in data.files:
             stored = bytes(data["__checksum__"]).decode()
             arrays_np = {
                 name: np.asarray(data[name])
-                for name in _FIELDS
+                for name in fields
                 if name in data.files
             }
             got = _digest(meta_json, arrays_np)
@@ -176,18 +258,18 @@ def _restore_state_inner(path: str):
                     f" (stored {stored[:12]}..., recomputed {got[:12]}...):"
                     " content corrupted after write"
                 )
-        spec = SketchSpec(
-            relative_accuracy=meta["relative_accuracy"],
-            mapping_name=meta["mapping_name"],
-            n_bins=meta["n_bins"],
-            key_offset=meta["key_offset"],
-            dtype=jnp.dtype(meta["dtype"]),
-            # Pre-r3 checkpoints carry no bin_dtype: bins followed dtype.
-            bin_dtype=jnp.dtype(meta.get("bin_dtype", meta["dtype"])),
-        )
         arrays = {
-            name: jnp.asarray(data[name]) for name in _FIELDS if name in data
+            name: jnp.asarray(data[name]) for name in fields if name in data
         }
+        if spec.backend != "dense":
+            missing = [n for n in fields if n not in arrays]
+            if missing:
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r} ({spec.backend} backend) is"
+                    f" missing state members {missing}"
+                )
+            state = _arrays_to_backend_state(spec, arrays)
+            return spec, state, stored_fp
         # Pre-adaptive-window checkpoints (round <= 2) carry no per-stream
         # offsets: every stream was on the spec default.
         if "key_offset" not in arrays:
@@ -254,9 +336,21 @@ def save(
         save_state(path, sketch.spec, sketch.state)
 
 
-def restore(path: str, engine: str = "auto") -> BatchedDDSketch:
-    """Resume a checkpoint as a batched facade (engine re-selected here)."""
+def restore(path: str, engine: str = "auto"):
+    """Resume a checkpoint as the facade matching its backend.
+
+    Dense checkpoints restore a ``BatchedDDSketch`` (engine re-selected
+    here); ``uniform_collapse``/``moment`` checkpoints restore their
+    backend facades with levels/moments intact.  Corrupt archives raise
+    ``CheckpointCorrupt`` via :func:`restore_state`.
+    """
     spec, state = restore_state(path)
+    if spec.backend != "dense":
+        from sketches_tpu.backends import facade_for
+
+        return facade_for(
+            state.n_streams, spec=spec, state=state, engine=engine
+        )
     return BatchedDDSketch(
         state.n_streams, spec=spec, state=state, engine=engine
     )

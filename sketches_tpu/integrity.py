@@ -246,6 +246,10 @@ _MIX2 = np.uint64(0x94D049BB133111EB)
 _SALT_POS = np.uint64(0x736B706F73)  # "skpos"
 _SALT_NEG = np.uint64(0x736B6E6567)  # "skneg"
 _SALT_ZERO = np.uint64(0x736B7A65726F)  # "skzero"
+_SALT_LEVEL = np.uint64(0x736B6C766C)  # "sklvl" (adaptive collapse level)
+_SALT_MOM_P = np.uint64(0x736B6D6F6D)  # "skmom" (moment power sums)
+_SALT_MOM_L = np.uint64(0x736B6D6C67)  # "skmlg" (moment log-power sums)
+_SALT_MOM_C = np.uint64(0x736B6D6374)  # "skmct" (moment counters)
 
 #: Fingerprint comparison tolerance: additivity holds exactly in real
 #: arithmetic; the f32 bin adds of a merge/fold and the f64 dot-product
@@ -280,6 +284,17 @@ def fingerprint(spec, state) -> np.ndarray:
     """
     import jax
 
+    if hasattr(state, "powers"):  # MomentState (backends.moment)
+        return _fingerprint_moment(state)
+    if hasattr(state, "base") and hasattr(state, "level"):
+        # AdaptiveState (backends.uniform): the dense lane plus a level
+        # term, so two states whose bins coincide at different levels
+        # (different content!) fingerprint apart.  The level term is
+        # NOT merge-additive -- adaptive merge seams fingerprint the
+        # level-ALIGNED bases instead (backends.uniform.merge).
+        base_fp = fingerprint(spec, state.base)
+        lvl = np.asarray(jax.device_get(state.level), np.int64)
+        return base_fp + lvl * _coeff(lvl, _SALT_LEVEL)
     bins_pos, bins_neg, zero, koff = (
         np.asarray(a)
         for a in jax.device_get(
@@ -297,6 +312,61 @@ def _fingerprint_arrays(bins_pos, bins_neg, zero, koff) -> np.ndarray:
     fp += (bins_neg.astype(np.float64) * _coeff(keys, _SALT_NEG)).sum(-1)
     fp += zero.astype(np.float64) * _coeff(np.zeros((), np.int64), _SALT_ZERO)
     return fp
+
+
+def _fingerprint_moment(mstate) -> np.ndarray:
+    """Merge-additive content checksum of a moment state -> f64 [N].
+
+    Coefficients key on the moment ORDER (the moment analog of the
+    absolute-bin-key scheme); every term is a sum, so the fingerprint
+    is additive under merge/psum exactly like the dense lane.  ``sum``
+    and min/max are excluded: a NaN-poisoned sum (live-NaN ingest,
+    documented) would make every comparison fail, and extrema are not
+    additive.  Saturated (inf) power sums propagate inf -- such states
+    compare unequal to everything, which degrades to cache misses, not
+    wrong answers.  Never raises on a well-shaped state.
+    """
+    import jax
+
+    count, zero, neg, powers, log_powers = (
+        np.asarray(a, np.float64)
+        for a in jax.device_get(
+            (mstate.count, mstate.zero_count, mstate.neg_count,
+             mstate.powers, mstate.log_powers)
+        )
+    )
+    orders = np.arange(1, powers.shape[-1] + 1, dtype=np.int64)
+    fp = (powers * _coeff(orders, _SALT_MOM_P)).sum(-1)
+    fp += (log_powers * _coeff(orders, _SALT_MOM_L)).sum(-1)
+    fp += count * _coeff(np.asarray(1, np.int64), _SALT_MOM_C)
+    fp += zero * _coeff(np.asarray(2, np.int64), _SALT_MOM_C)
+    fp += neg * _coeff(np.asarray(3, np.int64), _SALT_MOM_C)
+    return fp
+
+
+def verify_moment_merge(
+    spec, merged, fp_pre, seam: str = "moment.merge"
+) -> "IntegrityReport":
+    """The moment backend's merge conservation lane: the merged state's
+    (additive) fingerprint must equal the operands' sum; also runs the
+    moment invariants.  Violations raise ``IntegrityError``/quarantine
+    per the armed mode."""
+    report = check_state(spec, merged, seam=seam)
+    fp_post = _fingerprint_moment(merged)
+    pre = np.asarray(fp_pre, np.float64)
+    ok_shape = pre.shape == fp_post.shape
+    if not ok_shape:
+        report.add(0, "fingerprint",
+                   "pre-merge fingerprint has the wrong shape")
+    else:
+        finite = np.isfinite(pre) & np.isfinite(fp_post)
+        bad = finite & (
+            np.abs(fp_post - pre) > _FP_ATOL + _FP_RTOL * np.abs(pre)
+        )
+        _flag(report, bad, "fingerprint",
+              lambda i: f"merged moment fingerprint {fp_post[i]:g} !="
+              f" operand sum {pre[i]:g}")
+    return _record(report, None)
 
 
 def fingerprint_host(sketch) -> float:
@@ -349,6 +419,21 @@ def check_state(spec, state, seam: str = "state") -> IntegrityReport:
     """
     import jax
 
+    if hasattr(state, "powers"):  # MomentState (backends.moment)
+        return _check_moment(state, seam=seam)
+    if hasattr(state, "base") and hasattr(state, "level"):
+        # AdaptiveState: the base IS a dense state; the level array
+        # adds two invariants of its own.
+        report = check_state(spec, state.base, seam=seam)
+        lvl = np.asarray(jax.device_get(state.level))
+        _flag(report, lvl < 0, "level_nonnegative",
+              lambda i: f"collapse level {lvl[i]} < 0")
+        cap = getattr(spec, "max_collapses", None)
+        if cap is not None:
+            _flag(report, lvl > cap, "level_cap",
+                  lambda i: f"collapse level {lvl[i]} > max_collapses"
+                  f" {cap}")
+        return report
     fields = (
         state.bins_pos, state.bins_neg, state.zero_count, state.count,
         state.sum, state.min, state.max, state.collapsed_low,
@@ -491,6 +576,51 @@ def _check_state_arrays(
     )
     _flag(report, bad, "empty_identity",
           lambda i: "count == 0 but mass/sum/extrema are not identities")
+    return report
+
+
+def _check_moment(mstate, seam: str = "moment") -> IntegrityReport:
+    """Invariant check for a moment-summary state (pure; no raise).
+
+    Invariants: non-negative counters, ``zero + neg <= count`` (f32
+    rounding slack), finite extrema with ``min <= max`` wherever a
+    stream holds nonzero mass, and the +/-inf empty-stream sentinels.
+    Violations land in the returned report; poisoned sums (live-NaN
+    ingest) and saturated power sums are DOCUMENTED states, not
+    violations.
+    """
+    import jax
+
+    count, zero, neg, vmin, vmax = (
+        np.asarray(a, np.float64)
+        for a in jax.device_get(
+            (mstate.count, mstate.zero_count, mstate.neg_count,
+             mstate.min, mstate.max)
+        )
+    )
+    n = count.shape[-1]
+    if count.ndim == 2:  # stacked partials: flatten the shard axis
+        k2 = count.shape[0]
+        count, zero, neg, vmin, vmax = (
+            a.reshape(k2 * n) for a in (count, zero, neg, vmin, vmax)
+        )
+        n = count.shape[0]
+    report = IntegrityReport(seam=seam, n_streams=n)
+    for name, arr in (("count", count), ("zero_count", zero),
+                      ("neg_count", neg)):
+        _flag(report, arr < -_ATOL, f"{name}_nonnegative",
+              lambda i, a=arr, nm=name: f"{nm} {a[i]:g} < 0")
+    _flag(report, zero + neg > count * (1 + _RTOL) + _ATOL,
+          "mass_partition",
+          lambda i: f"zero {zero[i]:g} + neg {neg[i]:g} > count"
+          f" {count[i]:g}")
+    nonzero = count - zero > _ATOL
+    bad_extrema = nonzero & ~(
+        np.isfinite(vmin) & np.isfinite(vmax) & (vmin <= vmax)
+    )
+    _flag(report, bad_extrema, "extrema",
+          lambda i: f"min {vmin[i]:g} / max {vmax[i]:g} invalid for a"
+          " stream with nonzero mass")
     return report
 
 
@@ -809,7 +939,14 @@ def verify_restore(
             report.add(0, "fingerprint",
                        "stored fingerprint has the wrong shape")
         else:
-            bad = np.abs(fp_now - sf) > _FP_ATOL + _FP_RTOL * np.abs(sf)
+            # Saturated (inf) moment fingerprints subtract to NaN; the
+            # comparison is only meaningful where both sides are finite
+            # (documented degraded comparison for inf-poisoned sums).
+            with np.errstate(invalid="ignore"):
+                bad = (
+                    np.isfinite(fp_now) & np.isfinite(sf)
+                    & (np.abs(fp_now - sf) > _FP_ATOL + _FP_RTOL * np.abs(sf))
+                )
             _flag(report, bad, "fingerprint",
                   lambda i: f"restored fingerprint {fp_now[i]:g} != saved"
                   f" {sf[i]:g}")

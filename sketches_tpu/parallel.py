@@ -671,6 +671,16 @@ class DistributedDDSketch:
             auto_recenter = spec is None and "key_offset" not in spec_kwargs
         if spec is None:
             spec = SketchSpec(**spec_kwargs)
+        if spec.backend != "dense":
+            # The distributed facade's fold/reshard machinery is
+            # dense-state-shaped; adaptive/moment fleets distribute
+            # through their own backends.uniform/moment psum_merge and
+            # fold_hosts seams instead of this facade.
+            raise SpecError(
+                f"DistributedDDSketch requires backend='dense'; got"
+                f" {spec.backend!r} (use sketches_tpu.backends"
+                " psum_merge/fold_hosts for adaptive/moment fleets)"
+            )
         self.spec = spec
         # Mesh resolution: a rebuildable SketchMesh (the elastic path), a
         # bare jax Mesh (honored as-is; reshard then needs an explicit

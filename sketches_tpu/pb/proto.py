@@ -95,8 +95,17 @@ class KeyMappingProto:
         try:
             mapping_cls = _INTERPOLATION_TO_MAPPING[proto.interpolation]
         except KeyError:
+            # proto3 open enums parse unknown values through: refuse
+            # LOUDLY, naming the enum and the value -- decoding bins
+            # under a guessed key function would silently corrupt every
+            # quantile (same forward-compat contract as the
+            # SketchPayload.Backend enum in backends.wirefmt).
+            known = sorted(int(v) for v in _INTERPOLATION_TO_MAPPING)
             raise WireDecodeError(
-                f"Unsupported interpolation {proto.interpolation}"
+                "unknown IndexMapping.Interpolation enum value"
+                f" {int(proto.interpolation)}: refusing to decode"
+                f" (emitter is newer than this reader; known values"
+                f" {known})"
             ) from None
         if (
             mapping_cls is LinearlyInterpolatedMapping
@@ -182,7 +191,18 @@ def batched_to_bytes(spec, state) -> List[bytes]:
     """Serialize every stream of a device batch straight to wire BYTES --
     the bulk fast path (VERDICT r4 item 2): a vectorized encoder emitting
     protobuf output byte-identical to ``to_proto + SerializeToString``
-    without materializing host sketches or message objects."""
+    without materializing host sketches or message objects.
+
+    Non-dense backends (``spec.backend`` of ``uniform_collapse`` /
+    ``moment``) emit backend-tagged ``SketchPayload`` envelopes instead
+    (``sketches_tpu.backends.wirefmt``) -- self-describing, refused
+    loudly by readers that do not know the backend enum value; a state
+    type that disagrees with the spec's backend raises ``SpecError``.
+    """
+    if getattr(spec, "backend", "dense") != "dense":
+        from sketches_tpu.backends.wirefmt import payload_to_bytes
+
+        return payload_to_bytes(spec, state)
     from sketches_tpu.pb.wire import state_to_bytes
 
     return state_to_bytes(spec, state)
@@ -213,9 +233,21 @@ def batched_from_proto(
 
 def batched_from_bytes(
     spec, blobs, *, assume_native_linear: bool = False
-) -> "SketchState":  # noqa: F821
+):
     """Decode raw wire blobs into one device batch -- the bulk fast path
-    (foreign-emitter wire quirks handled by the C++ parser)."""
+    (foreign-emitter wire quirks handled by the C++ parser).
+
+    Non-dense specs decode ``SketchPayload`` envelopes into their
+    backend state (``AdaptiveState`` / ``MomentState``); an unknown
+    backend enum value, a backend/spec mismatch, or structural damage
+    raises ``WireDecodeError`` naming the problem.
+    """
+    if getattr(spec, "backend", "dense") != "dense":
+        from sketches_tpu.backends.wirefmt import payload_from_bytes
+
+        return payload_from_bytes(
+            spec, blobs, assume_native_linear=assume_native_linear
+        )
     from sketches_tpu.pb.wire import bytes_to_state
 
     return bytes_to_state(

@@ -345,9 +345,25 @@ class SketchServer:
                     stream_axis=stream_axis, **kwargs,
                 )
             else:
-                from sketches_tpu.batched import BatchedDDSketch
+                # Per-tenant accuracy/memory contract: the spec's
+                # backend picks the facade class (dense BatchedDDSketch,
+                # uniform_collapse AdaptiveDDSketch, or moment
+                # MomentDDSketch) -- mixed-backend fleets serve
+                # correctly because cache keys are fingerprint-derived
+                # and fused groups key on the (backend-carrying) spec.
+                _backend = getattr(
+                    kwargs.get("spec"), "backend",
+                    kwargs.get("backend", "dense"),
+                )
+                if _backend != "dense":
+                    from sketches_tpu.backends import facade_for
 
-                facade = BatchedDDSketch(n_streams, **kwargs)
+                    facade = facade_for(n_streams, **kwargs)
+                else:
+                    from sketches_tpu.batched import BatchedDDSketch
+
+                    kwargs.pop("backend", None)
+                    facade = BatchedDDSketch(n_streams, **kwargs)
             self._tenants[name] = _Tenant(name, facade)
             return facade
 
@@ -791,9 +807,22 @@ class SketchServer:
 
             import jax
 
-            from sketches_tpu.batched import quantile
+            backend = getattr(spec, "backend", "dense")
+            if backend == "uniform_collapse":
+                from sketches_tpu.backends.uniform import quantile as _aq
 
-            fn = jax.jit(functools.partial(quantile, spec))
+                fn = jax.jit(functools.partial(_aq, spec))
+            elif backend == "moment":
+                from sketches_tpu.backends.moment import quantile as _mq
+
+                # Host maxent solve: a plain callable, not a jit -- the
+                # fused stacking still answers every same-spec tenant
+                # in one call.
+                fn = functools.partial(_mq, spec)
+            else:
+                from sketches_tpu.batched import quantile
+
+                fn = jax.jit(functools.partial(quantile, spec))
             self._fused_jits[spec] = fn
         return fn
 

@@ -257,6 +257,26 @@ _DECLARED = (
     Metric("accuracy.collapsed_mass_frac", "gauge", "sketches_tpu.accuracy",
            "Fraction of a watched stream's mass clamped into the window"
            " edge bins at the most recent audit (label: stream)."),
+    Metric("accuracy.collapse_recommended", "counter",
+           "sketches_tpu.accuracy",
+           "Drift audits that saw a non-adaptive stream's edge-clamped"
+           " mass fraction cross its spec's collapse threshold -- the"
+           " signal that the stream wants the uniform_collapse backend"
+           " (label: stream)."),
+    Metric("backend.collapses", "counter", "sketches_tpu.backends",
+           "Uniform-collapse events: streams whose bins pair-merged one"
+           " level (gamma -> gamma**2; alpha degraded predictably"
+           " instead of tail mass clamping)."),
+    Metric("backend.effective_alpha", "gauge", "sketches_tpu.backends",
+           "Realized relative-accuracy bound of a collapsed stream"
+           " after its most recent collapse (label: stream)."),
+    Metric("backend.moment_solves", "counter", "sketches_tpu.backends",
+           "Per-stream maximum-entropy quantile solves run by the"
+           " moment backend."),
+    Metric("backend.moment_fallbacks", "counter", "sketches_tpu.backends",
+           "Moment-backend solves that fell back down the moment ladder"
+           " (fewer moments, or the uniform-density floor) because the"
+           " maxent Newton solve failed to converge."),
     Metric("elastic.reshards", "counter", "sketches_tpu.parallel",
            "Elastic reshard operations completed (grow, shrink, and"
            " kill-and-regrow alike; label: kind)."),

@@ -158,6 +158,16 @@ class Exponential(Dataset):
         self.data = list(rng.exponential(2.0, self.size).astype(float))
 
 
+class Pareto(Dataset):
+    """Power-law tail (shape a=1.5): the heavy-tailed stress case the
+    moment backend's documented error envelope is pinned on."""
+
+    def populate(self):
+        rng = np.random.RandomState(self.size + 8)
+        u = rng.uniform(0.0, 1.0, self.size)
+        self.data = list((1.0 / np.power(u, 1.0 / 1.5)).astype(float))
+
+
 class Laplace(Dataset):
     def populate(self):
         rng = np.random.RandomState(self.size + 5)

@@ -1,0 +1,981 @@
+"""Adaptive-accuracy backend subsystem (PR 10).
+
+Pins the three per-tenant accuracy/memory contracts behind the
+Store/KeyMapping seam:
+
+* **uniform_collapse** (UDDSketch, arXiv:2004.08604): collapse algebra
+  (mass conservation, level caps, merge-collapse commutation), the
+  alpha contract at the *effective* alpha after forced collapses, the
+  collapse triggers, and the ``SKETCHES_TPU_ADAPTIVE`` kill switch
+  refusing loudly;
+* **moment** (arXiv:1803.01969): <=256 bytes/stream, the documented
+  quantile error envelope on the uniform/lognormal/pareto datasets,
+  elementwise merge algebra, and NaN/zero/padding parity with the
+  dense tier;
+* both backends through every seam: wire envelope (unknown backend
+  enum refused loudly), checkpoint/restore (armed fingerprints),
+  psum_merge/fold_hosts, integrity fingerprints, and the serve tier's
+  per-tenant isolation with fingerprint-keyed caching.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sketches_tpu import checkpoint, integrity, telemetry
+from sketches_tpu.backends import (
+    BACKEND_ENUM,
+    facade_for,
+    moment as M,
+    uniform as U,
+)
+from sketches_tpu.backends.moment import MomentDDSketch
+from sketches_tpu.backends.uniform import AdaptiveDDSketch, AdaptiveState
+from sketches_tpu.backends.wirefmt import payload_from_bytes, payload_to_bytes
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu import batched
+from sketches_tpu.resilience import (
+    CheckpointCorrupt,
+    SpecError,
+    WireDecodeError,
+)
+
+import datasets
+
+QS = [0.1, 0.25, 0.5, 0.75, 0.9, 0.99]
+
+
+def aspec(n_bins=128, thr=0.05, alpha=0.01, **kw):
+    return SketchSpec(
+        relative_accuracy=alpha, n_bins=n_bins,
+        backend="uniform_collapse", collapse_threshold=thr, **kw
+    )
+
+
+def mspec(k=12, alpha=0.01):
+    return SketchSpec(relative_accuracy=alpha, backend="moment", n_moments=k)
+
+
+def exact_q(vals, qs=QS):
+    return np.stack(
+        [np.quantile(vals[i], qs, method="lower")
+         for i in range(vals.shape[0])]
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    was = telemetry.enabled()
+    telemetry.disable()
+    telemetry.reset()
+    integrity.disarm()
+    integrity.reset()
+    yield
+    integrity.disarm()
+    integrity.reset()
+    telemetry.reset()
+    telemetry.enable(was)
+
+
+# ---------------------------------------------------------------------------
+# Spec / registry / constructor seam
+# ---------------------------------------------------------------------------
+
+
+class TestSpec:
+    def test_unknown_backend_raises(self):
+        with pytest.raises(SpecError, match="backend"):
+            SketchSpec(backend="btree")
+
+    def test_uniform_collapse_requires_log_mapping(self):
+        with pytest.raises(SpecError, match="logarithmic"):
+            SketchSpec(backend="uniform_collapse", mapping_name="cubic")
+
+    def test_collapse_threshold_validated(self):
+        with pytest.raises(SpecError, match="collapse_threshold"):
+            SketchSpec(backend="uniform_collapse", collapse_threshold=1.5)
+
+    def test_n_moments_validated(self):
+        with pytest.raises(SpecError, match="n_moments"):
+            SketchSpec(backend="moment", n_moments=40)
+
+    def test_backend_changes_spec_identity(self):
+        a = SketchSpec()
+        b = SketchSpec(backend="moment")
+        assert a != b and hash(a) != hash(b)
+
+    def test_wire_enum_values_pinned(self):
+        # Append-only: decoders refuse unknown values, so these numbers
+        # are wire contract -- changing one silently misdecodes old
+        # blobs.
+        assert BACKEND_ENUM == {
+            "dense": 0, "uniform_collapse": 1, "moment": 2
+        }
+
+    def test_adaptive_kill_switch_declared(self):
+        from sketches_tpu.analysis import registry
+
+        v = registry.lookup("SKETCHES_TPU_ADAPTIVE")
+        assert v.default == "1"
+        assert registry.enabled(registry.ADAPTIVE)
+
+    def test_facade_for_dispatch(self):
+        assert isinstance(facade_for(2, spec=aspec()), AdaptiveDDSketch)
+        assert isinstance(facade_for(2, spec=mspec()), MomentDDSketch)
+        assert isinstance(
+            facade_for(2, spec=SketchSpec(n_bins=128)), BatchedDDSketch
+        )
+        assert isinstance(
+            facade_for(2, backend="moment", n_moments=8), MomentDDSketch
+        )
+        with pytest.raises(SpecError, match="contradicts"):
+            facade_for(2, backend="moment", spec=aspec())
+
+    def test_distributed_refuses_backend_specs(self):
+        from sketches_tpu.parallel import DistributedDDSketch
+
+        with pytest.raises(SpecError, match="dense"):
+            DistributedDDSketch(4, value_axis="values", spec=mspec())
+
+
+# ---------------------------------------------------------------------------
+# Uniform collapse: pure transforms
+# ---------------------------------------------------------------------------
+
+
+class TestCollapseAlgebra:
+    def test_collapse_conserves_mass_and_counters(self):
+        spec = aspec()
+        sk = AdaptiveDDSketch(4, spec=spec)
+        rng = np.random.RandomState(0)
+        vals = rng.lognormal(0, 1.0, (4, 256)).astype(np.float32)
+        sk.add(vals)
+        st0 = sk.state
+        st1 = U.collapse_once(spec, st0)
+        for field in ("count", "zero_count", "sum", "min", "max"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st0.base, field)),
+                np.asarray(getattr(st1.base, field)),
+            )
+        assert float(np.asarray(st1.base.bins_pos).sum()) == float(
+            np.asarray(st0.base.bins_pos).sum()
+        )
+        np.testing.assert_array_equal(np.asarray(st1.level),
+                                      np.asarray(st0.level) + 1)
+
+    def test_collapse_respects_level_cap(self):
+        spec = aspec()
+        st = U.init(spec, 2)
+        for _ in range(spec.max_collapses + 3):
+            st = U.collapse_once(spec, st)
+        assert int(np.asarray(st.level).max()) == spec.max_collapses
+
+    def test_collapse_to_is_monotone(self):
+        spec = aspec()
+        st = U.collapse_once(spec, U.init(spec, 2), jnp.asarray([True, False]))
+        out = U.collapse_to(spec, st, jnp.asarray([0, 3]))
+        # Levels never decrease; stream 1 reaches its target.
+        np.testing.assert_array_equal(np.asarray(out.level), [1, 3])
+
+    def test_effective_alpha_algebra(self):
+        spec = aspec(alpha=0.01)
+        lv = jnp.asarray([0, 1, 2])
+        ea = np.asarray(U.effective_alpha(spec, lv), np.float64)
+        g = spec.gamma
+        for i, L in enumerate([0, 1, 2]):
+            gl = g ** (2**L)
+            assert ea[i] == pytest.approx((gl - 1) / (gl + 1), rel=1e-5)
+
+    def test_premap_hits_level_keys_exactly(self):
+        spec = aspec()
+        rng = np.random.RandomState(1)
+        v = rng.lognormal(0, 3.0, (3, 512)).astype(np.float32)
+        v[1] *= -1.0
+        level = jnp.asarray([0, 2, 4], jnp.int32)
+        u = U.premap_values(spec, level, jnp.asarray(v))
+        k0 = np.asarray(spec.mapping.key_array(jnp.abs(jnp.asarray(v))))
+        ku = np.asarray(
+            spec.mapping.key_array(jnp.abs(jnp.asarray(u)))
+        )
+        for s, L in enumerate([0, 2, 4]):
+            want = -((-k0[s]) // (1 << L))  # ceil(k0 / 2**L)
+            np.testing.assert_array_equal(ku[s], want)
+        # signs preserved; level-0 rows bit-identical
+        assert (np.sign(np.asarray(u)) == np.sign(v)).all()
+        np.testing.assert_array_equal(np.asarray(u)[0], v[0])
+
+
+class TestAlphaContract:
+    """The acceptance criterion: the alpha-contract suite at the
+    EFFECTIVE alpha after forced collapses."""
+
+    @pytest.mark.parametrize("forced_levels", [1, 2, 3])
+    def test_forced_collapse_contract(self, forced_levels):
+        spec = aspec(thr=0.05)
+        sk = AdaptiveDDSketch(2, spec=spec)
+        sk.add(np.full((2, 4), 1.0, np.float32))  # seed, then force
+        for _ in range(forced_levels):
+            sk.collapse()
+        assert int(np.asarray(sk.level).min()) == forced_levels
+        rng = np.random.RandomState(7)
+        vals = rng.lognormal(0.0, 1.5, (2, 8192)).astype(np.float32)
+        sk.add(vals)
+        allv = np.concatenate(
+            [np.full((2, 4), 1.0, np.float32), vals], axis=1
+        )
+        got = np.asarray(sk.get_quantile_values(QS), np.float64)
+        want = exact_q(allv)
+        ea = np.asarray(sk.effective_alpha(), np.float64)
+        cf = np.asarray(sk.collapsed_fraction(), np.float64)
+        assert cf.max() <= spec.collapse_threshold + 1e-6
+        rel = np.abs(got - want) / np.abs(want)
+        assert (rel.max(axis=1) <= ea + 1e-6).all(), (rel.max(axis=1), ea)
+
+    def test_trigger_collapses_and_mass_exact(self):
+        spec = aspec(thr=0.05)
+        sk = AdaptiveDDSketch(4, spec=spec)
+        rng = np.random.RandomState(0)
+        total = 0
+        for sigma in (0.5, 2.0, 4.0):  # widening regimes force collapse
+            vals = rng.lognormal(0.0, sigma, (4, 1024)).astype(np.float32)
+            sk.add(vals)
+            total += vals.shape[1]
+        assert int(np.asarray(sk.level).min()) >= 1
+        np.testing.assert_array_equal(
+            np.asarray(sk.count, np.float64), float(total)
+        )
+        # the realized guarantee is surfaced per stream
+        ea = np.asarray(sk.effective_alpha())
+        assert (ea > spec.relative_accuracy).all()
+
+    def test_query_nan_contract(self):
+        sk = AdaptiveDDSketch(2, spec=aspec())
+        out = np.asarray(sk.get_quantile_values([0.5]))
+        assert np.isnan(out).all()  # empty streams answer NaN
+        sk.add(np.ones((2, 4), np.float32))
+        out = np.asarray(sk.get_quantile_values([-0.1, 0.5, 1.5]))
+        assert np.isnan(out[:, 0]).all() and np.isnan(out[:, 2]).all()
+        assert np.isfinite(out[:, 1]).all()
+
+
+class TestKillSwitch:
+    def test_explicit_collapse_refused(self, monkeypatch):
+        monkeypatch.setenv("SKETCHES_TPU_ADAPTIVE", "0")
+        sk = AdaptiveDDSketch(2, spec=aspec())
+        sk.add(np.ones((2, 8), np.float32))
+        with pytest.raises(SpecError, match="SKETCHES_TPU_ADAPTIVE"):
+            sk.collapse()
+
+    def test_trigger_refused_loudly(self, monkeypatch):
+        spec = aspec(thr=0.02)
+        sk = AdaptiveDDSketch(2, spec=spec)
+        rng = np.random.RandomState(3)
+        sk.add(rng.lognormal(0, 0.3, (2, 256)).astype(np.float32))
+        monkeypatch.setenv("SKETCHES_TPU_ADAPTIVE", "0")
+        wide = rng.lognormal(0, 6.0, (2, 1024)).astype(np.float32)
+        before = np.asarray(sk.count, np.float64).copy()
+        with pytest.raises(SpecError, match="SKETCHES_TPU_ADAPTIVE"):
+            sk.add(wide)
+        # the refused ingest left the facade untouched
+        np.testing.assert_array_equal(
+            np.asarray(sk.count, np.float64), before
+        )
+
+    def test_mixed_gamma_merge_refused(self, monkeypatch):
+        spec = aspec()
+        a = AdaptiveDDSketch(2, spec=spec)
+        b = AdaptiveDDSketch(2, spec=spec)
+        a.add(np.ones((2, 8), np.float32))
+        b.add(np.ones((2, 8), np.float32))
+        b.collapse()
+        monkeypatch.setenv("SKETCHES_TPU_ADAPTIVE", "0")
+        with pytest.raises(SpecError, match="mixed-gamma"):
+            a.merge(b)
+
+
+class TestMixedGammaMerge:
+    def test_merge_equals_merge_then_collapse_reference(self):
+        # Acceptance: merge of mixed-gamma states == merge-then-collapse
+        # (collapse is linear in the bins; unit weights keep it exact,
+        # fingerprints are recenter-invariant so windows don't matter).
+        spec = aspec()
+        rng = np.random.RandomState(5)
+        a = AdaptiveDDSketch(2, spec=spec)
+        b = AdaptiveDDSketch(2, spec=spec)
+        a.add(rng.lognormal(0, 1.0, (2, 512)).astype(np.float32))
+        b.add(rng.lognormal(1.0, 2.5, (2, 1024)).astype(np.float32))
+        sa, sb = a.state, b.state
+        merged = U.merge(spec, sa, sb)
+        deeper = np.asarray(merged.level) + 1
+        lhs = U.collapse_to(spec, merged, jnp.asarray(deeper))
+        rhs = U.merge(
+            spec,
+            U.collapse_to(spec, sa, jnp.asarray(deeper)),
+            U.collapse_to(spec, sb, jnp.asarray(deeper)),
+        )
+        np.testing.assert_allclose(
+            integrity.fingerprint(spec, lhs.base),
+            integrity.fingerprint(spec, rhs.base),
+            rtol=1e-9, atol=1e-6,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(lhs.base.count), np.asarray(rhs.base.count)
+        )
+
+    def test_mixed_merge_mass_conserved_and_within_alpha(self):
+        spec = aspec()
+        rng = np.random.RandomState(6)
+        a = AdaptiveDDSketch(2, spec=spec)
+        b = AdaptiveDDSketch(2, spec=spec)
+        va = rng.lognormal(0, 1.0, (2, 512)).astype(np.float32)
+        vb = rng.lognormal(2.0, 3.0, (2, 2048)).astype(np.float32)
+        a.add(va)
+        b.add(vb)
+        a.merge(b)
+        allv = np.concatenate([va, vb], axis=1)
+        assert float(np.asarray(a.count, np.float64).sum()) == allv.size
+        got = np.asarray(a.get_quantile_values(QS), np.float64)
+        want = exact_q(allv)
+        ea = np.asarray(a.effective_alpha(), np.float64)
+        rel = np.abs(got - want) / np.abs(want)
+        assert (rel.max(axis=1) <= ea + 0.01).all()
+
+    def test_merge_is_fingerprint_accounted_when_armed(self):
+        integrity.arm("raise")
+        spec = aspec()
+        a = AdaptiveDDSketch(2, spec=spec)
+        b = AdaptiveDDSketch(2, spec=spec)
+        rng = np.random.RandomState(8)
+        a.add(rng.lognormal(0, 0.5, (2, 128)).astype(np.float32))
+        b.add(rng.lognormal(0, 0.5, (2, 128)).astype(np.float32))
+        b.collapse()
+        a.merge(b)  # must not raise: aligned-operand lane verifies
+        assert float(np.asarray(a.count, np.float64).sum()) == 512.0
+
+
+class TestUniformDistributed:
+    def test_psum_merge_mixed_levels(self):
+        spec = aspec(alpha=0.02)
+        rng = np.random.RandomState(2)
+        parts = []
+        for i in range(4):
+            st = U.init(spec, 2)
+            st = AdaptiveState(
+                batched.add(
+                    spec, st.base,
+                    jnp.asarray(
+                        rng.lognormal(0, 0.5, (2, 128)).astype(np.float32)
+                    ),
+                ),
+                st.level,
+            )
+            if i == 2:
+                st = U.collapse_once(spec, st)
+            parts.append(st)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("values",))
+
+        def body(st):
+            st = jax.tree.map(lambda x: x[0], st)
+            return U.psum_merge(spec, st, "values")
+
+        fold = shard_map(
+            body, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("values"), stacked),),
+            out_specs=jax.tree.map(lambda _: P(), parts[0]),
+        )(stacked)
+        ref = parts[0]
+        for p in parts[1:]:
+            ref = U.merge(spec, ref, p)
+        np.testing.assert_array_equal(
+            np.asarray(fold.level), np.asarray(ref.level)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fold.base.count), np.asarray(ref.base.count)
+        )
+        np.testing.assert_allclose(
+            integrity.fingerprint(spec, fold.base),
+            integrity.fingerprint(spec, ref.base),
+            rtol=1e-6, atol=1e-3,
+        )
+
+    def test_fold_hosts_accounts_unreachable(self):
+        spec = aspec(alpha=0.02)
+        rng = np.random.RandomState(4)
+        parts = []
+        for i in range(3):
+            st = U.init(spec, 2)
+            st = AdaptiveState(
+                batched.add(
+                    spec, st.base,
+                    jnp.asarray(
+                        rng.lognormal(0, 0.5, (2, 64)).astype(np.float32)
+                    ),
+                ),
+                st.level,
+            )
+            parts.append(st)
+        folded, report = U.fold_hosts(
+            spec, parts, reachable=[True, False, True]
+        )
+        assert report.dropped_count.sum() == 128.0
+        assert float(np.asarray(folded.base.count, np.float64).sum()) == 256.0
+
+    def test_fold_hosts_all_dead_raises(self):
+        from sketches_tpu.resilience import ShardLossError
+
+        spec = aspec()
+        parts = [U.init(spec, 2) for _ in range(2)]
+        with pytest.raises(ShardLossError):
+            U.fold_hosts(spec, parts, reachable=[False, False])
+
+
+# ---------------------------------------------------------------------------
+# Moment backend
+# ---------------------------------------------------------------------------
+
+
+class TestMoment:
+    def test_bytes_per_stream_under_contract(self):
+        for k in (2, 8, 16):
+            spec = mspec(k=k)
+            assert M.bytes_per_stream(spec) <= 256
+        sk = MomentDDSketch(100, n_moments=12)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves(sk.state))
+        assert nbytes / 100 <= 256
+
+    @pytest.mark.parametrize(
+        "dataset,mid_tol,tail_tol",
+        [
+            (datasets.UniformForward, 0.05, 0.05),
+            (datasets.Lognormal, 0.05, 0.15),
+            (datasets.Pareto, 0.05, 0.15),
+        ],
+    )
+    def test_error_envelope_on_datasets(self, dataset, mid_tol, tail_tol):
+        # The documented envelope (NOT the dense alpha contract): a few
+        # percent mid-distribution, 15% at p99 on heavy tails.
+        data = dataset(20000)
+        vals = np.asarray(data.data, np.float32)[None, :]
+        sk = MomentDDSketch(1, n_moments=12)
+        sk.add(vals[:, :10000])
+        sk.add(vals[:, 10000:])  # merge-by-ingest across batches
+        got = sk.get_quantile_values(QS)[0]
+        for qi, q in enumerate(QS):
+            want = data.quantile(q)
+            tol = tail_tol if q >= 0.95 else mid_tol
+            assert abs(got[qi] - want) <= tol * abs(want) + 1e-9, (
+                dataset.__name__, q, got[qi], want,
+            )
+
+    def test_merge_matches_single_ingest(self):
+        rng = np.random.RandomState(1)
+        vals = rng.lognormal(0, 2.0, (3, 4096)).astype(np.float32)
+        whole = MomentDDSketch(3, n_moments=10)
+        whole.add(vals)
+        a = MomentDDSketch(3, n_moments=10)
+        b = MomentDDSketch(3, n_moments=10)
+        a.add(vals[:, :1024])
+        b.add(vals[:, 1024:])
+        a.merge(b)
+        np.testing.assert_array_equal(
+            np.asarray(a.count), np.asarray(whole.count)
+        )
+        np.testing.assert_allclose(
+            a.get_quantile_values(QS), whole.get_quantile_values(QS),
+            rtol=0.05, atol=1e-5,
+        )
+
+    def test_merge_spec_mismatch_raises(self):
+        from sketches_tpu.ddsketch import UnequalSketchParametersError
+
+        a = MomentDDSketch(2, n_moments=8)
+        b = MomentDDSketch(2, n_moments=10)
+        with pytest.raises(UnequalSketchParametersError):
+            a.merge(b)
+
+    def test_zero_nan_padding_parity(self):
+        sk = MomentDDSketch(2, n_moments=8)
+        vals = np.asarray(
+            [[0.0, 1.0, np.nan, 2.0], [5.0, 5.0, 5.0, 5.0]], np.float32
+        )
+        weights = np.asarray(
+            [[1.0, 1.0, 1.0, 0.0], [1.0, 0.0, 1.0, 1.0]], np.float32
+        )
+        sk.add(vals, weights)
+        count = np.asarray(sk.state.count, np.float64)
+        zero = np.asarray(sk.state.zero_count, np.float64)
+        np.testing.assert_array_equal(count, [3.0, 3.0])  # padding inert
+        np.testing.assert_array_equal(zero, [2.0, 0.0])  # 0 + NaN
+        assert np.isnan(float(np.asarray(sk.state.sum)[0]))  # NaN poisons
+        assert float(np.asarray(sk.state.min)[1]) == 5.0
+
+    def test_empty_and_zero_only_streams(self):
+        sk = MomentDDSketch(2, n_moments=8)
+        sk.add(np.asarray([[0.0, 0.0], [0.0, 0.0]], np.float32),
+               np.asarray([[1.0, 1.0], [0.0, 0.0]], np.float32))
+        out = sk.get_quantile_values([0.5])
+        assert out[0, 0] == 0.0  # zero-only stream answers 0
+        assert np.isnan(out[1, 0])  # empty stream answers NaN
+
+    def test_mixed_sign_raw_basis(self):
+        rng = np.random.RandomState(2)
+        vals = rng.uniform(-50.0, 50.0, (1, 20000)).astype(np.float32)
+        sk = MomentDDSketch(1, n_moments=12)
+        sk.add(vals)
+        got = sk.get_quantile_values([0.1, 0.5, 0.9])[0]
+        want = np.quantile(vals[0], [0.1, 0.5, 0.9])
+        span = float(vals.max() - vals.min())
+        assert (np.abs(got - want) <= 0.03 * span).all()
+
+    def test_psum_merge_matches_host_fold(self):
+        spec = mspec(k=8)
+        rng = np.random.RandomState(3)
+        parts = [
+            M.add(
+                spec, M.init(spec, 2),
+                jnp.asarray(
+                    rng.lognormal(0, 1.5, (2, 256)).astype(np.float32)
+                ),
+            )
+            for _ in range(4)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *parts)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("values",))
+
+        def body(st):
+            st = jax.tree.map(lambda x: x[0], st)
+            return M.psum_merge(st, "values")
+
+        fold = shard_map(
+            body, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P("values"), stacked),),
+            out_specs=jax.tree.map(lambda _: P(), parts[0]),
+        )(stacked)
+        ref = functools.reduce(
+            lambda x, y: M.merge(spec, x, y), parts
+        )
+        for f in ("count", "zero_count", "neg_count", "min", "max"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(fold, f)), np.asarray(getattr(ref, f))
+            )
+        np.testing.assert_allclose(
+            np.asarray(fold.powers), np.asarray(ref.powers), rtol=1e-5
+        )
+
+    def test_fold_hosts_moment(self):
+        spec = mspec(k=8)
+        rng = np.random.RandomState(9)
+        parts = [
+            M.add(
+                spec, M.init(spec, 2),
+                jnp.asarray(
+                    rng.lognormal(0, 1.0, (2, 64)).astype(np.float32)
+                ),
+            )
+            for _ in range(3)
+        ]
+        folded, report = M.fold_hosts(
+            spec, parts, reachable=[False, True, True]
+        )
+        assert report.dropped_count.sum() == 128.0
+        assert float(np.asarray(folded.count, np.float64).sum()) == 256.0
+
+    def test_resolved_tier_is_moment(self):
+        sk = MomentDDSketch(1, n_moments=8)
+        sk.add(np.ones((1, 8), np.float32))
+        tier, vals = sk.get_quantile_values_resolved(
+            [0.5], disabled_tiers=("overlap", "tiles")
+        )
+        assert tier == "moment"
+        assert np.isfinite(vals).all()
+        assert sk._query_choice((0.5,))[0] == "moment"
+
+
+# ---------------------------------------------------------------------------
+# Wire envelope
+# ---------------------------------------------------------------------------
+
+
+class TestWire:
+    def _adaptive(self, seed=1):
+        spec = aspec()
+        sk = AdaptiveDDSketch(3, spec=spec)
+        rng = np.random.RandomState(seed)
+        sk.add(rng.lognormal(1.0, 3.0, (3, 1024)).astype(np.float32))
+        return spec, sk
+
+    def test_adaptive_roundtrip(self):
+        spec, sk = self._adaptive()
+        blobs = payload_to_bytes(spec, sk.state)
+        assert all(b[:1] == b"\x08" for b in blobs)  # envelope magic
+        st2 = payload_from_bytes(spec, blobs)
+        np.testing.assert_array_equal(
+            np.asarray(st2.level), np.asarray(sk.level)
+        )
+        q1 = np.asarray(sk.get_quantile_values(QS))
+        q2 = np.asarray(U.quantile(spec, st2, jnp.asarray(QS, jnp.float32)))
+        np.testing.assert_allclose(q1, q2, rtol=1e-5)
+
+    def test_moment_roundtrip_bit_exact(self):
+        spec = mspec(k=10)
+        sk = MomentDDSketch(3, spec=spec)
+        rng = np.random.RandomState(2)
+        sk.add(rng.lognormal(0, 2.0, (3, 512)).astype(np.float32))
+        st2 = payload_from_bytes(spec, payload_to_bytes(spec, sk.state))
+        for f in ("count", "zero_count", "neg_count", "sum", "min", "max",
+                  "powers", "log_powers"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(sk.state, f)),
+                np.asarray(getattr(st2, f)),
+            )
+
+    def test_unknown_backend_enum_refused_loudly(self):
+        spec, sk = self._adaptive()
+        blob = payload_to_bytes(spec, sk.state)[0]
+        forged = b"\x08\x07" + blob[2:]  # backend enum -> 7
+        with pytest.raises(WireDecodeError, match="Backend enum value 7"):
+            payload_from_bytes(spec, [forged])
+
+    def test_backend_spec_mismatch_refused(self):
+        spec, sk = self._adaptive()
+        blobs = payload_to_bytes(spec, sk.state)
+        with pytest.raises(WireDecodeError, match="spec wants"):
+            payload_from_bytes(mspec(), blobs)
+        with pytest.raises(WireDecodeError, match="dense"):
+            payload_from_bytes(SketchSpec(n_bins=128), blobs)
+
+    def test_truncated_envelope_refused(self):
+        spec, sk = self._adaptive()
+        blob = payload_to_bytes(spec, sk.state)[0]
+        with pytest.raises(WireDecodeError):
+            payload_from_bytes(spec, [blob[: len(blob) // 2]])
+
+    def test_moment_k_mismatch_refused(self):
+        spec = mspec(k=8)
+        sk = MomentDDSketch(1, spec=spec)
+        sk.add(np.ones((1, 4), np.float32))
+        blobs = payload_to_bytes(spec, sk.state)
+        with pytest.raises(WireDecodeError, match="k="):
+            payload_from_bytes(mspec(k=12), blobs)
+
+    def test_proto_bridge_dispatches_backends(self):
+        from sketches_tpu.pb.proto import batched_from_bytes, batched_to_bytes
+
+        spec, sk = self._adaptive()
+        st2 = batched_from_bytes(spec, batched_to_bytes(spec, sk.state))
+        assert isinstance(st2, AdaptiveState)
+
+    def test_state_type_mismatch_raises_specerror(self):
+        spec, sk = self._adaptive()
+        with pytest.raises(SpecError):
+            payload_to_bytes(mspec(), sk.state)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpoint:
+    def test_adaptive_roundtrip_with_armed_fingerprint(self, tmp_path):
+        integrity.arm("raise")
+        spec = aspec()
+        sk = AdaptiveDDSketch(3, spec=spec)
+        rng = np.random.RandomState(3)
+        sk.add(rng.lognormal(0.5, 2.5, (3, 1024)).astype(np.float32))
+        path = str(tmp_path / "a.ckpt")
+        checkpoint.save(path, sk)
+        restored = checkpoint.restore(path)
+        assert isinstance(restored, AdaptiveDDSketch)
+        np.testing.assert_array_equal(
+            np.asarray(restored.level), np.asarray(sk.level)
+        )
+        np.testing.assert_allclose(
+            np.asarray(restored.get_quantile_values(QS)),
+            np.asarray(sk.get_quantile_values(QS)),
+            rtol=1e-6,
+        )
+
+    def test_moment_roundtrip_bit_exact(self, tmp_path):
+        integrity.arm("raise")
+        sk = MomentDDSketch(3, n_moments=9)
+        rng = np.random.RandomState(4)
+        sk.add(rng.lognormal(0, 1.0, (3, 256)).astype(np.float32))
+        path = str(tmp_path / "m.ckpt")
+        checkpoint.save(path, sk)
+        restored = checkpoint.restore(path)
+        assert isinstance(restored, MomentDDSketch)
+        assert restored.spec == sk.spec
+        for f in ("count", "sum", "powers", "log_powers", "min", "max"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(restored.state, f)),
+                np.asarray(getattr(sk.state, f)),
+            )
+
+    def test_corrupted_backend_checkpoint_refused(self, tmp_path):
+        sk = MomentDDSketch(2, n_moments=8)
+        sk.add(np.ones((2, 8), np.float32))
+        path = str(tmp_path / "m.ckpt")
+        checkpoint.save(path, sk)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(raw)
+        with pytest.raises(CheckpointCorrupt):
+            checkpoint.restore(path)
+
+    def test_partials_refused_for_backend_facades(self, tmp_path):
+        sk = MomentDDSketch(2, n_moments=8)
+        with pytest.raises(SpecError):
+            checkpoint.save(str(tmp_path / "p.ckpt"), sk, partials=True)
+
+
+# ---------------------------------------------------------------------------
+# Serve tier: mixed-backend fleet
+# ---------------------------------------------------------------------------
+
+
+class TestServe:
+    def _server(self):
+        from sketches_tpu import serve
+
+        srv = serve.SketchServer()
+        srv.add_tenant("adaptive", 4, spec=aspec())
+        srv.add_tenant("moment", 4, spec=mspec())
+        srv.add_tenant("dense", 4, spec=SketchSpec(n_bins=256))
+        return srv
+
+    def test_mixed_backend_fleet_answers_concurrently(self):
+        srv = self._server()
+        rng = np.random.RandomState(5)
+        v = rng.lognormal(0, 1.5, (4, 2048)).astype(np.float32)
+        for name in ("adaptive", "moment", "dense"):
+            srv.ingest(name, v)
+        tickets = [
+            srv.submit(n, [0.5, 0.9])
+            for n in ("adaptive", "moment", "dense")
+        ]
+        out = srv.flush()
+        assert len(out) == 3
+        ex = np.stack([np.quantile(v[i], [0.5, 0.9]) for i in range(4)])
+        for t in tickets:
+            got = np.asarray(t.result.values, np.float64)
+            rel = np.abs(got - ex) / np.abs(ex)
+            assert rel.max() < 0.25, (t.tenant, rel.max())
+
+    def test_cache_hits_stay_poison_free_across_backends(self):
+        srv = self._server()
+        rng = np.random.RandomState(6)
+        v = rng.lognormal(0, 1.0, (4, 512)).astype(np.float32)
+        for name in ("adaptive", "moment", "dense"):
+            srv.ingest(name, v)
+        first = [
+            srv.submit(n, [0.5]) for n in ("adaptive", "moment", "dense")
+        ]
+        srv.flush()
+        second = [
+            srv.submit(n, [0.5]) for n in ("adaptive", "moment", "dense")
+        ]
+        srv.flush()
+        assert all(t.result.cached for t in second)
+        assert srv.stats()["cache_poisoned"] == 0
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(
+                np.asarray(a.result.values), np.asarray(b.result.values)
+            )
+
+    def test_write_invalidates_backend_tenants(self):
+        srv = self._server()
+        v = np.ones((4, 64), np.float32)
+        srv.ingest("moment", v)
+        t1 = srv.submit("moment", [0.5])
+        srv.flush()
+        srv.ingest("moment", 3.0 * v)
+        t2 = srv.submit("moment", [0.5])
+        srv.flush()
+        assert not t2.result.cached
+        assert not np.array_equal(
+            np.asarray(t1.result.values), np.asarray(t2.result.values)
+        )
+
+    def test_same_spec_adaptive_tenants_fuse(self):
+        # Two adaptive tenants sharing a spec take the stacked
+        # cross-tenant fused dispatch; levels ride the stacked pytree
+        # and the decode correction stays per-stream-correct.
+        from sketches_tpu import serve
+
+        srv = serve.SketchServer()
+        spec = aspec(alpha=0.02)
+        srv.add_tenant("a1", 2, spec=spec)
+        srv.add_tenant("a2", 2, spec=spec)
+        rng = np.random.RandomState(11)
+        v1 = rng.lognormal(0, 0.5, (2, 512)).astype(np.float32)
+        v2 = rng.lognormal(0, 3.0, (2, 2048)).astype(np.float32)
+        srv.ingest("a1", v1)
+        srv.ingest("a2", v2)  # wide: this tenant collapses
+        t1 = srv.submit("a1", [0.5])
+        t2 = srv.submit("a2", [0.5])
+        srv.flush()
+        for t, v, sk_name in ((t1, v1, "a1"), (t2, v2, "a2")):
+            got = np.asarray(t.result.values, np.float64)[:, 0]
+            want = np.quantile(v, 0.5, axis=1)
+            ea = np.asarray(
+                srv.tenant(sk_name).effective_alpha(), np.float64
+            )
+            assert (np.abs(got - want) / np.abs(want) <= ea + 0.02).all()
+
+    def test_same_spec_moment_tenants_fuse(self):
+        from sketches_tpu import serve
+
+        srv = serve.SketchServer()
+        spec = mspec(k=8)
+        srv.add_tenant("m1", 2, spec=spec)
+        srv.add_tenant("m2", 2, spec=spec)
+        rng = np.random.RandomState(7)
+        srv.ingest("m1", rng.lognormal(0, 1.0, (2, 256)).astype(np.float32))
+        srv.ingest("m2", rng.lognormal(1.0, 1.0, (2, 256)).astype(np.float32))
+        t1 = srv.submit("m1", [0.5])
+        t2 = srv.submit("m2", [0.5])
+        srv.flush()
+        assert np.isfinite(np.asarray(t1.result.values)).all()
+        assert np.isfinite(np.asarray(t2.result.values)).all()
+
+
+# ---------------------------------------------------------------------------
+# Integrity dispatch + accuracy recommendation counter
+# ---------------------------------------------------------------------------
+
+
+class TestIntegrityDispatch:
+    def test_adaptive_fingerprint_sensitive_to_level(self):
+        spec = aspec()
+        sk = AdaptiveDDSketch(2, spec=spec)
+        sk.add(np.ones((2, 16), np.float32))
+        fp0 = integrity.fingerprint(spec, sk.state)
+        sk.collapse()
+        fp1 = integrity.fingerprint(spec, sk.state)
+        assert not np.allclose(fp0, fp1)
+
+    def test_moment_fingerprint_merge_additive(self):
+        spec = mspec(k=8)
+        rng = np.random.RandomState(8)
+        a = M.add(
+            spec, M.init(spec, 2),
+            jnp.asarray(rng.lognormal(0, 1.0, (2, 128)).astype(np.float32)),
+        )
+        b = M.add(
+            spec, M.init(spec, 2),
+            jnp.asarray(rng.lognormal(0, 1.0, (2, 128)).astype(np.float32)),
+        )
+        fp_sum = integrity.fingerprint(spec, a) + integrity.fingerprint(
+            spec, b
+        )
+        fp_merged = integrity.fingerprint(spec, M.merge(spec, a, b))
+        np.testing.assert_allclose(fp_merged, fp_sum, rtol=1e-6, atol=1e-3)
+
+    def test_moment_invariant_checker_catches_corruption(self):
+        spec = mspec(k=8)
+        sk = MomentDDSketch(2, spec=spec)
+        sk.add(np.ones((2, 16), np.float32))
+        import dataclasses
+
+        bad = dataclasses.replace(
+            sk.state, count=jnp.asarray([-5.0, 16.0], jnp.float32)
+        )
+        report = integrity.check_state(spec, bad, seam="test")
+        assert report  # truthy: violations caught
+        assert any(
+            v.invariant == "count_nonnegative" for v in report.violations
+        )
+
+    def test_armed_moment_merge_verifies(self):
+        integrity.arm("raise")
+        a = MomentDDSketch(2, n_moments=8)
+        b = MomentDDSketch(2, n_moments=8)
+        a.add(np.ones((2, 16), np.float32))
+        b.add(2.0 * np.ones((2, 16), np.float32))
+        a.merge(b)  # additive fingerprint lane must pass
+        np.testing.assert_array_equal(
+            np.asarray(a.count, np.float64), [32.0, 32.0]
+        )
+
+
+class TestCollapseRecommended:
+    def test_audit_emits_counter_for_non_adaptive_stream(self):
+        from sketches_tpu import accuracy
+
+        telemetry.enable()
+        accuracy.reset()
+        accuracy.enable()
+        try:
+            spec = SketchSpec(relative_accuracy=0.02, n_bins=64)
+            sk = BatchedDDSketch(2, spec=spec, auto_recenter=False)
+            accuracy.watch(sk, "clamping", streams=(0, 1), interval=1)
+            rng = np.random.RandomState(9)
+            # a 64-bin window cannot hold sigma=4 lognormal: mass clamps
+            for _ in range(3):
+                sk.add(rng.lognormal(0, 4.0, (2, 512)).astype(np.float32))
+                accuracy.observe_ingest(sk, np.ones((2, 1), np.float32))
+            accuracy.audit_now("clamping")
+            snap = telemetry.snapshot()
+            counters = snap["counters"]
+            hits = [
+                v for k, v in counters.items()
+                if k.startswith("accuracy.collapse_recommended")
+            ]
+            assert hits and sum(hits) >= 1.0
+        finally:
+            accuracy.disable()
+            accuracy.reset()
+
+    def test_no_counter_for_adaptive_backend(self):
+        from sketches_tpu import accuracy
+
+        telemetry.enable()
+        accuracy.reset()
+        accuracy.enable()
+        try:
+            sk = AdaptiveDDSketch(2, spec=aspec(thr=0.3))
+            accuracy.watch(sk, "adaptive", streams=(0,), interval=1)
+            rng = np.random.RandomState(10)
+            sk.add(rng.lognormal(0, 4.0, (2, 512)).astype(np.float32))
+            accuracy.audit_now("adaptive")
+            counters = telemetry.snapshot()["counters"]
+            assert not any(
+                k.startswith("accuracy.collapse_recommended")
+                for k in counters
+            )
+        finally:
+            accuracy.disable()
+            accuracy.reset()
+
+
+# ---------------------------------------------------------------------------
+# Chaos campaign (short smoke; CI runs the long soak)
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveCampaign:
+    def test_campaign_is_deterministic_and_clean(self):
+        from sketches_tpu import chaos
+
+        v1 = chaos.run_adaptive_campaign(40, seed=13)
+        v2 = chaos.run_adaptive_campaign(40, seed=13)
+        assert v1["ok"], (v1["errors"], v1["outcomes"])
+        assert v1["outcomes"].get("undetected", 0) == 0
+        assert v1["final_count"] == v1["expected_count"]
+        assert v1["events"] == v2["events"]  # seeded: replays exactly
+
+    def test_campaign_rejects_bad_steps(self):
+        from sketches_tpu import chaos
+        from sketches_tpu.resilience import SketchValueError
+
+        with pytest.raises(SketchValueError):
+            chaos.run_adaptive_campaign(0, seed=1)

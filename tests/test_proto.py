@@ -95,8 +95,74 @@ def test_quadratic_interpolation_decodes():
 def test_unsupported_interpolation_raises():
     proto = pb.IndexMapping(gamma=1.02)
     proto.ParseFromString(proto.SerializeToString() + b"\x18\x07")  # enum = 7
-    with pytest.raises(ValueError, match="interpolation"):
+    with pytest.raises(ValueError, match="Interpolation"):
         KeyMappingProto.from_proto(proto)
+
+
+# ---------------------------------------------------------------------------
+# Forward compatibility: unknown enum values decode REFUSED, loudly,
+# with the enum named (a newer emitter must never silently misdecode
+# through an older reader).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("value", [4, 7, 200])
+def test_unknown_interpolation_enum_names_enum_and_value(value):
+    from sketches_tpu.resilience import WireDecodeError
+
+    proto = pb.IndexMapping(gamma=1.02)
+    # proto3 open enums: splice the raw varint so the parsed message
+    # carries an enum value this reader has no mapping for.
+    suffix = b"\x18" + bytes([value]) if value < 128 else (
+        b"\x18" + bytes([(value & 0x7F) | 0x80, value >> 7])
+    )
+    proto.ParseFromString(proto.SerializeToString() + suffix)
+    with pytest.raises(WireDecodeError) as ei:
+        KeyMappingProto.from_proto(proto)
+    msg = str(ei.value)
+    assert "IndexMapping.Interpolation" in msg  # the enum, by name
+    assert str(value) in msg  # the offending value
+    assert "known values" in msg  # and what this reader does support
+
+
+def test_unknown_interpolation_refused_through_full_sketch_decode():
+    from sketches_tpu.resilience import WireDecodeError
+
+    sk = DDSketch(0.01)
+    sk.add(1.0)
+    blob = bytearray(DDSketchProto.to_proto(sk).SerializeToString())
+    # The mapping submessage's interpolation field is absent for
+    # NONE=0 (proto3 default); append it INSIDE the mapping submessage
+    # by re-parsing a doctored mapping and re-serializing.
+    mapping = pb.IndexMapping()
+    mapping.ParseFromString(
+        DDSketchProto.to_proto(sk).mapping.SerializeToString() + b"\x18\x09"
+    )
+    msg = pb.DDSketch()
+    msg.ParseFromString(bytes(blob))
+    msg.mapping.CopyFrom(mapping)
+    with pytest.raises(WireDecodeError, match="Interpolation"):
+        DDSketchProto.from_proto(msg)
+
+
+def test_unknown_backend_enum_refused_through_proto_bridge():
+    from sketches_tpu.pb.proto import batched_from_bytes, batched_to_bytes
+    from sketches_tpu.resilience import WireDecodeError
+
+    spec = SketchSpec(
+        relative_accuracy=0.02, n_bins=64, backend="uniform_collapse"
+    )
+    from sketches_tpu.backends.uniform import AdaptiveDDSketch
+
+    sk = AdaptiveDDSketch(1, spec=spec)
+    sk.add(np.ones((1, 8), np.float32))
+    blob = batched_to_bytes(spec, sk.state)[0]
+    assert blob[:2] == b"\x08\x01"  # backend enum = UNIFORM_COLLAPSE
+    forged = b"\x08\x63" + blob[2:]  # enum -> 99
+    with pytest.raises(WireDecodeError) as ei:
+        batched_from_bytes(spec, [forged])
+    msg = str(ei.value)
+    assert "SketchPayload.Backend" in msg and "99" in msg
 
 
 def test_store_proto_rejects_unknown_store():
