@@ -1,0 +1,386 @@
+// Native bulk wire codec: structural-template decode over packed blob
+// arrays (ROADMAP item 1 -- the 100k-decode <= 1 s letter).
+//
+// The Python canonical walker (sketches_tpu/pb/wire.py::_parse_canonical)
+// is the semantic oracle: this scanner accepts AT MOST what that walker
+// accepts, extracts byte-identical facts (payload doubles, zigzag-decoded
+// sint32 store offsets truncated to 32 bits, trailing zeroCount), and
+// hands ANYTHING else back to Python blob-by-blob via a per-blob status
+// ("careful-path handoff contract", docs/DESIGN.md section 17).  Being
+// conservative is always safe -- a careful blob decodes through the
+// protobuf reference path with identical placement semantics -- so every
+// branch below errs toward status != 0 rather than guessing.
+//
+// Framing invariants assumed for a status-0 (fast-path) dense blob:
+//   * blob starts with the caller's expected serialized `mapping` field
+//     (memcmp-equal bytes -- this certifies the spec's mapping);
+//   * at most one positiveValues (0x12) and one negativeValues (0x1a)
+//     store field, each `<len> [0x12 <plen> <packed doubles>
+//     [0x18 <zigzag sint32 offset>]]`, plen a multiple of 8, the offset
+//     varint (when present) ending exactly at the store body's end;
+//   * any number of trailing/interleaved zeroCount (0x21) doubles, last
+//     one winning (protobuf scalar-field semantics);
+//   * every declared length lands inside the blob (a truncated blob is
+//     a careful blob -- protobuf's DecodeError must fire, never a
+//     silent slice-clamp);
+//   * varints may be non-minimal; values with significant bits past 64
+//     are treated as "huge" and fail any length check (matching Python's
+//     arbitrary-precision comparison), while the store-offset varint
+//     truncates to its low 32 bits before zigzag decode (protobuf sint32
+//     semantics, ADVICE r5 item 1).
+//
+// Payload doubles are memcpy'd little-endian into the caller's aligned
+// staging buffer (the wire format is LE; this scanner assumes an LE
+// host, which the ctypes loader asserts before enabling it).
+//
+// ABI: every symbol here is versioned through ddsk_wire_abi_version();
+// the Python loader refuses the fast path (degrading to the pure-Python
+// walker, never corrupting) when the constant disagrees -- a stale .so
+// built from older sources answers the old version number.  Bump
+// kWireAbiVersion on ANY signature or output-layout change.
+//
+// Build: `make -C native` links this into libddsketch_host.so alongside
+// the host-tier engine (plain C ABI, no pybind11).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kWireAbiVersion = 1;
+
+// Per-blob scan statuses (the Python side folds 1/2/3 into "careful").
+enum Status : uint8_t {
+  kOk = 0,
+  kCarefulForeign = 1,   // prefix/envelope mismatch: foreign or damaged
+  kCarefulTemplate = 2,  // prefix matched, structure deviated
+  kPreMarked = 3,        // caller pre-marked (over admission cap): skip
+};
+
+struct Varint {
+  uint64_t value;  // low 64 bits
+  bool huge;       // significant bits at/above 2^64 were dropped
+  bool ok;         // terminated inside [pos, end)
+  size_t next;
+};
+
+// Reads one varint; mirrors Python's arbitrary-precision read in the only
+// two ways callers consume it: exact low 64 bits, plus a "huge" flag so
+// length comparisons treat >= 2^64 values as larger than any blob.
+Varint read_varint(const uint8_t* p, size_t pos, size_t end) {
+  Varint r{0, false, false, pos};
+  uint64_t v = 0;
+  bool huge = false;
+  int shift = 0;
+  while (pos < end) {
+    const uint8_t b = p[pos++];
+    const uint64_t bits = b & 0x7F;
+    if (shift < 64) {
+      if (shift > 57 && (bits >> (64 - shift)) != 0) huge = true;
+      v |= bits << shift;
+    } else if (bits != 0) {
+      huge = true;
+    }
+    if (!(b & 0x80)) {
+      r.value = v;
+      r.huge = huge;
+      r.ok = true;
+      r.next = pos;
+      return r;
+    }
+    shift += 7;
+  }
+  return r;  // ran off the end mid-varint
+}
+
+struct Run {
+  size_t payload_off = 0;  // absolute byte offset of the packed doubles
+  long long len8 = 0;      // trimmed run length, in doubles (0 = no run)
+  long long j0 = 0;        // window start: decoded key offset - base
+};
+
+// Walks one canonical blob body past its mapping prefix; the exact
+// mirror of pb/wire.py::_parse_canonical.  Returns false for ANY
+// non-canonical shape (careful-path handoff).
+bool scan_dense_body(const uint8_t* buf, size_t pos, size_t end,
+                     long long base, Run runs[2], double* zc) {
+  int seen = 0;  // bit 0 = positiveValues parsed, bit 1 = negativeValues
+  *zc = 0.0;
+  runs[0] = Run();
+  runs[1] = Run();
+  size_t j = pos;
+  while (j < end) {
+    const uint8_t tag = buf[j];
+    if (tag == 0x12 || tag == 0x1A) {
+      const int which = (tag == 0x1A) ? 1 : 0;
+      const int bit = which ? 2 : 1;
+      if ((seen & bit) || j + 1 >= end) return false;
+      seen |= bit;
+      const Varint ln = read_varint(buf, j + 1, end);
+      if (!ln.ok || ln.huge || ln.value > (uint64_t)(end - ln.next)) {
+        return false;  // declared length leaves the blob
+      }
+      const size_t end_body = ln.next + (size_t)ln.value;
+      j = ln.next;
+      if (ln.value == 0) continue;  // canonical empty store submessage
+      if (buf[j] != 0x12 || j + 1 >= end_body) return false;
+      const Varint pl = read_varint(buf, j + 1, end);
+      if (!pl.ok || pl.huge || (pl.value & 7) ||
+          pl.value > (uint64_t)(end - pl.next)) {
+        return false;
+      }
+      const size_t p0 = pl.next;
+      const size_t pend = p0 + (size_t)pl.value;
+      if (pend > end_body) return false;
+      long long key_off = 0;
+      if (pend < end_body) {
+        if (buf[pend] != 0x18 || pend + 1 >= end_body) return false;
+        const Varint z = read_varint(buf, pend + 1, end);
+        if (!z.ok || z.next != end_body) return false;
+        // Protobuf sint32: truncate to the low 32 bits, then zigzag.
+        const uint32_t zm = (uint32_t)(z.value & 0xFFFFFFFFull);
+        key_off = (long long)(zm >> 1) ^ -(long long)(zm & 1);
+      }
+      // Trim the run's trailing all-zero chunk padding at the
+      // 8-byte-rounded cut (a double with any nonzero byte survives
+      // whole) -- same rstrip discipline as the Python walker.
+      size_t kept = pend;
+      while (kept > p0 && buf[kept - 1] == 0) --kept;
+      const long long t_len = (long long)((kept - p0 + 7) >> 3);
+      if (t_len) {
+        runs[which].payload_off = p0;
+        runs[which].len8 = t_len;
+        runs[which].j0 = key_off - base;
+      }
+      j = end_body;
+    } else if (tag == 0x21) {  // zeroCount double (last occurrence wins)
+      if (j + 9 > end) return false;
+      std::memcpy(zc, buf + j + 1, 8);
+      j += 9;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ddsk_wire_abi_version() { return kWireAbiVersion; }
+
+// Structural scan of `n` packed dense blobs.
+//
+//   buf        concatenated blob bytes
+//   offsets    int64[n+1] blob boundaries into buf
+//   prefix     the expected serialized `mapping` field bytes
+//   base       spec.key_offset (window starts are returned relative to it)
+//   status     uint8[n] in/out: nonzero entries on entry are skipped
+//              (caller pre-marked, e.g. over the admission cap); on exit
+//              0 = fast-parsed, nonzero = careful-path handoff
+//   zc         double[n] out: zeroCount per fast-parsed blob (0 if absent)
+//   run_pos    int64[2n] out: start of each run's doubles in payload_out
+//              (slot 2i = positive store, 2i+1 = negative store)
+//   run_len    int64[2n] out: trimmed run length in doubles (0 = no run)
+//   run_j0     int64[2n] out: window start (decoded key offset - base)
+//   payload_out double[] out: aligned staging; capacity must be at least
+//              (offsets[n] / 8) doubles (trimmed payloads can never
+//              exceed the input bytes)
+//
+// Returns the number of careful blobs, or -1 on invalid arguments.
+long long ddsk_wire_scan_dense(const uint8_t* buf, long long n,
+                               const long long* offsets,
+                               const uint8_t* prefix, long long prefix_len,
+                               long long base, uint8_t* status, double* zc,
+                               long long* run_pos, long long* run_len,
+                               long long* run_j0, double* payload_out) {
+  if (n < 0 || prefix_len < 0) return -1;
+  long long careful = 0;
+  long long cursor = 0;  // doubles written into payload_out
+  for (long long i = 0; i < n; ++i) {
+    run_pos[2 * i] = run_pos[2 * i + 1] = 0;
+    run_len[2 * i] = run_len[2 * i + 1] = 0;
+    run_j0[2 * i] = run_j0[2 * i + 1] = 0;
+    zc[i] = 0.0;
+    if (status[i]) {  // pre-marked by the caller: hands off untouched
+      ++careful;
+      continue;
+    }
+    const long long a = offsets[i], b = offsets[i + 1];
+    if (b - a < prefix_len ||
+        std::memcmp(buf + a, prefix, (size_t)prefix_len) != 0) {
+      status[i] = kCarefulForeign;
+      ++careful;
+      continue;
+    }
+    Run runs[2];
+    double z;
+    if (!scan_dense_body(buf, (size_t)(a + prefix_len), (size_t)b, base,
+                         runs, &z)) {
+      status[i] = kCarefulTemplate;
+      ++careful;
+      continue;
+    }
+    zc[i] = z;
+    for (int w = 0; w < 2; ++w) {
+      if (runs[w].len8 <= 0) continue;
+      std::memcpy(payload_out + cursor, buf + runs[w].payload_off,
+                  (size_t)runs[w].len8 * 8);
+      run_pos[2 * i + w] = cursor;
+      run_len[2 * i + w] = runs[w].len8;
+      run_j0[2 * i + w] = runs[w].j0;
+      cursor += runs[w].len8;
+    }
+  }
+  return careful;
+}
+
+// Splits `n` packed SketchPayload envelopes of the emitter's canonical
+// uniform_collapse shape -- `0x08 <backend> 0x12 <len> <dense blob>
+// 0x18 <level>`, nothing else, ending exactly at the blob end -- into
+// per-blob (dense sub-blob range, collapse level).  The dense sub-blob
+// is NOT scanned here: the caller feeds the ranges back through the
+// dense bulk decode (which itself dispatches to ddsk_wire_scan_dense),
+// so telemetry/integrity/error semantics stay byte-identical with the
+// Python path.  Any deviation -- wrong backend enum, reordered or
+// unknown fields, truncation, a level varint past 2^31 (Python formats
+// the exact value in its refusal) -- is a careful handoff.
+//
+// Outputs: status uint8[n] (in/out, as above), level int64[n],
+// dense_off/dense_len int64[n] (absolute byte range into buf).
+// Returns the number of careful blobs, or -1 on invalid arguments.
+long long ddsk_wire_scan_envelope(const uint8_t* buf, long long n,
+                                  const long long* offsets,
+                                  long long expected_backend,
+                                  uint8_t* status, long long* level,
+                                  long long* dense_off,
+                                  long long* dense_len) {
+  if (n < 0) return -1;
+  long long careful = 0;
+  for (long long i = 0; i < n; ++i) {
+    level[i] = 0;
+    dense_off[i] = 0;
+    dense_len[i] = 0;
+    if (status[i]) {
+      ++careful;
+      continue;
+    }
+    const size_t a = (size_t)offsets[i], b = (size_t)offsets[i + 1];
+    size_t j = a;
+    bool ok = false;
+    do {
+      if (j >= b || buf[j] != 0x08) break;
+      const Varint backend = read_varint(buf, j + 1, b);
+      if (!backend.ok || backend.huge ||
+          backend.value != (uint64_t)expected_backend) {
+        break;
+      }
+      j = backend.next;
+      if (j >= b || buf[j] != 0x12) break;
+      const Varint ln = read_varint(buf, j + 1, b);
+      if (!ln.ok || ln.huge || ln.value > (uint64_t)(b - ln.next)) break;
+      const size_t d0 = ln.next, d1 = ln.next + (size_t)ln.value;
+      j = d1;
+      if (j >= b || buf[j] != 0x18) break;
+      const Varint lv = read_varint(buf, j + 1, b);
+      // Levels past 2^31 hand off so Python can format the true value
+      // in its range refusal.
+      if (!lv.ok || lv.huge || lv.value > 0x7FFFFFFFull) break;
+      if (lv.next != b) break;  // canonical envelopes end at the level
+      level[i] = (long long)lv.value;
+      dense_off[i] = (long long)d0;
+      dense_len[i] = (long long)(d1 - d0);
+      ok = true;
+    } while (false);
+    if (!ok) {
+      status[i] = kCarefulForeign;
+      ++careful;
+    }
+  }
+  return careful;
+}
+
+// Scans `n` packed moment-backend SketchPayload envelopes of the
+// emitter's canonical shape -- `0x08 <backend> 0x22 <len>` wrapping a
+// MomentPayload `0x08 <k> 0x12 48 <6 doubles> 0x1a <8k> <k doubles>
+// 0x22 <8k> <k doubles>`, both ending exactly where declared -- and
+// copies the values straight into the caller's arrays.  A k that
+// disagrees with the spec's, or any structural deviation, hands off
+// (Python raises its exact k-mismatch/structure refusal).
+//
+// Outputs: status uint8[n] (in/out), scalars double[n*6]
+// (count/zero/neg/sum/min/max rows), powers/log_powers double[n*k].
+// Careful rows are left untouched (the caller pre-fills defaults).
+// Returns the number of careful blobs, or -1 on invalid arguments.
+long long ddsk_wire_scan_moment(const uint8_t* buf, long long n,
+                                const long long* offsets,
+                                long long expected_backend, long long k,
+                                uint8_t* status, double* scalars,
+                                double* powers, double* log_powers) {
+  if (n < 0 || k < 0) return -1;
+  long long careful = 0;
+  const uint64_t k8 = (uint64_t)k * 8;
+  for (long long i = 0; i < n; ++i) {
+    if (status[i]) {
+      ++careful;
+      continue;
+    }
+    const size_t a = (size_t)offsets[i], b = (size_t)offsets[i + 1];
+    size_t j = a;
+    bool ok = false;
+    do {
+      if (j >= b || buf[j] != 0x08) break;
+      const Varint backend = read_varint(buf, j + 1, b);
+      if (!backend.ok || backend.huge ||
+          backend.value != (uint64_t)expected_backend) {
+        break;
+      }
+      j = backend.next;
+      if (j >= b || buf[j] != 0x22) break;
+      const Varint ln = read_varint(buf, j + 1, b);
+      if (!ln.ok || ln.huge || ln.value > (uint64_t)(b - ln.next)) break;
+      const size_t mend = ln.next + (size_t)ln.value;
+      j = ln.next;
+      if (mend != b) break;  // canonical envelopes end at the payload
+      // MomentPayload: k, then the three packed-double runs in order.
+      if (j >= mend || buf[j] != 0x08) break;
+      const Varint kv = read_varint(buf, j + 1, mend);
+      if (!kv.ok || kv.huge || kv.value != (uint64_t)k) break;
+      j = kv.next;
+      if (j >= mend || buf[j] != 0x12) break;
+      const Varint sl = read_varint(buf, j + 1, mend);
+      if (!sl.ok || sl.value != 48 || 48 > (uint64_t)(mend - sl.next)) break;
+      const size_t s0 = sl.next;
+      j = s0 + 48;
+      if (j >= mend || buf[j] != 0x1A) break;
+      const Varint pw = read_varint(buf, j + 1, mend);
+      if (!pw.ok || pw.huge || pw.value != k8 ||
+          k8 > (uint64_t)(mend - pw.next)) {
+        break;
+      }
+      const size_t p0 = pw.next;
+      j = p0 + (size_t)k8;
+      if (j >= mend || buf[j] != 0x22) break;
+      const Varint lw = read_varint(buf, j + 1, mend);
+      if (!lw.ok || lw.huge || lw.value != k8 ||
+          k8 > (uint64_t)(mend - lw.next)) {
+        break;
+      }
+      const size_t l0 = lw.next;
+      if (l0 + (size_t)k8 != mend) break;  // payload ends at log_powers
+      std::memcpy(scalars + i * 6, buf + s0, 48);
+      std::memcpy(powers + i * k, buf + p0, (size_t)k8);
+      std::memcpy(log_powers + i * k, buf + l0, (size_t)k8);
+      ok = true;
+    } while (false);
+    if (!ok) {
+      status[i] = kCarefulForeign;
+      ++careful;
+    }
+  }
+  return careful;
+}
+
+}  // extern "C"

@@ -66,7 +66,7 @@ from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 from sketches_tpu import backends
 
-__version__ = "0.14.0"
+__version__ = "0.15.0"
 
 __all__ = [
     "BaseDDSketch",

@@ -46,6 +46,7 @@ import numpy as np
 
 import jax
 
+from sketches_tpu import telemetry
 from sketches_tpu.backends import BACKEND_ENUM, BACKEND_NAMES
 from sketches_tpu.resilience import SpecError, WireDecodeError
 
@@ -281,6 +282,24 @@ def _parse_moment(payload: bytes):
     return k, scalars, powers, log_powers
 
 
+def _pack_blobs(blobs):
+    """Concatenate ``blobs`` for a native scan -> (buf, offsets int64[n+1])."""
+    n = len(blobs)
+    lens = np.fromiter((len(b) for b in blobs), np.int64, n)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return b"".join(blobs), offsets
+
+
+def _native_scan_counters(n_careful: int) -> None:
+    if telemetry._ACTIVE:
+        telemetry.counter_inc("wire.native.decode_calls")
+        if n_careful:
+            telemetry.counter_inc(
+                "wire.native.careful_fallbacks", float(n_careful)
+            )
+
+
 def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
     """Decode envelope (or plain dense) blobs into one backend state.
 
@@ -314,9 +333,56 @@ def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
     if want == "uniform_collapse":
         from sketches_tpu.pb.wire import bytes_to_state
 
-        dense_blobs: List[bytes] = []
-        levels: List[int] = []
-        for idx, blob in enumerate(blobs):
+        n = len(blobs)
+        dense_blobs: List[bytes] = [b""] * n
+        levels: List[int] = [0] * n
+        scanner = None
+        if n:
+            from sketches_tpu import native
+
+            scanner = native.wire_scanner()
+        if scanner is not None:
+            # Native envelope split: one C++ scan extracts each
+            # canonical envelope's (dense sub-blob range, level); the
+            # dense sub-blobs then ride the stock bulk decode below, so
+            # its telemetry/integrity/error semantics apply unchanged.
+            # Careful handoffs (and levels the range gate refuses) are
+            # re-examined per blob in batch order, so refusals name the
+            # same first offender as the pure-Python walk.
+            from sketches_tpu.native import _i64ptr, _u8ptr
+
+            buf, offsets = _pack_blobs([bytes(b) for b in blobs])
+            status = np.zeros(n, np.uint8)
+            level_arr = np.zeros(n, np.int64)
+            doff = np.zeros(n, np.int64)
+            dlen = np.zeros(n, np.int64)
+            n_careful = scanner.ddsk_wire_scan_envelope(
+                buf, n, _i64ptr(offsets), ENUM[want], _u8ptr(status),
+                _i64ptr(level_arr), _i64ptr(doff), _i64ptr(dlen),
+            )
+            if n_careful < 0:
+                status[:] = 1
+                n_careful = n
+            _native_scan_counters(int(n_careful))
+            ok = status == 0
+            bad_level = ok & (
+                (level_arr < 0) | (level_arr > spec.max_collapses)
+            )
+            for idx in np.nonzero(ok & ~bad_level)[0].tolist():
+                dense_blobs[idx] = buf[doff[idx] : doff[idx] + dlen[idx]]
+                levels[idx] = int(level_arr[idx])
+            problems = np.nonzero(~ok | bad_level)[0].tolist()
+        else:
+            problems = list(range(n))
+        for idx in problems:
+            blob = blobs[idx]
+            if scanner is not None and status[idx] == 0:
+                # Native-parsed envelope whose level fails the range
+                # gate: refuse with the exact walker message.
+                raise WireDecodeError(
+                    f"blob {idx}: collapse level {int(level_arr[idx])}"
+                    f" outside [0, {spec.max_collapses}]"
+                )
             backend, dense, level, _ = _parse_payload(bytes(blob))
             if backend != ENUM[want]:
                 raise WireDecodeError(
@@ -334,8 +400,8 @@ def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
                     f"blob {idx}: collapse level {level} outside"
                     f" [0, {spec.max_collapses}]"
                 )
-            dense_blobs.append(dense)
-            levels.append(level)
+            dense_blobs[idx] = dense
+            levels[idx] = level
         from sketches_tpu.backends.uniform import AdaptiveState
 
         base = bytes_to_state(
@@ -349,15 +415,36 @@ def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
 
     n = len(blobs)
     k_spec = spec.n_moments
-    count = np.zeros((n,), np.float64)
-    zero = np.zeros((n,), np.float64)
-    neg = np.zeros((n,), np.float64)
-    total = np.zeros((n,), np.float64)
-    vmin = np.full((n,), np.inf, np.float64)
-    vmax = np.full((n,), -np.inf, np.float64)
+    # Packed scalar rows: [count, zero, neg, sum, min, max] per stream;
+    # the native scanner copies straight into these arrays for canonical
+    # envelopes, careful blobs fill in through the Python walker below.
+    scal = np.zeros((n, 6), np.float64)
+    scal[:, 4] = np.inf
+    scal[:, 5] = -np.inf
     powers = np.zeros((n, k_spec), np.float64)
     log_powers = np.zeros((n, k_spec), np.float64)
-    for idx, blob in enumerate(blobs):
+    scanner = None
+    if n:
+        from sketches_tpu import native
+
+        scanner = native.wire_scanner()
+    if scanner is not None:
+        from sketches_tpu.native import _dptr, _i64ptr, _u8ptr
+
+        buf, offsets = _pack_blobs([bytes(b) for b in blobs])
+        status = np.zeros(n, np.uint8)
+        n_careful = scanner.ddsk_wire_scan_moment(
+            buf, n, _i64ptr(offsets), ENUM[want], k_spec, _u8ptr(status),
+            _dptr(scal), _dptr(powers), _dptr(log_powers),
+        )
+        if n_careful < 0:
+            status[:] = 1
+        careful_idx = np.nonzero(status)[0].tolist()
+        _native_scan_counters(len(careful_idx))
+    else:
+        careful_idx = list(range(n))
+    for idx in careful_idx:
+        blob = blobs[idx]
         backend, _, _, moment = _parse_payload(bytes(blob))
         if backend != ENUM[want]:
             raise WireDecodeError(
@@ -375,11 +462,12 @@ def payload_from_bytes(spec, blobs, *, assume_native_linear: bool = False):
                 f"blob {idx}: moment payload has k={k}, spec wants"
                 f" k={k_spec}"
             )
-        count[idx], zero[idx], neg[idx], total[idx], vmin[idx], vmax[idx] = (
-            scalars
-        )
+        scal[idx] = scalars
         powers[idx] = p
         log_powers[idx] = lp
+    count, zero, neg, total, vmin, vmax = (
+        np.ascontiguousarray(scal[:, c]) for c in range(6)
+    )
     dt = np.dtype(jnp.dtype(spec.dtype).name)
 
     def cast(a):

@@ -28,7 +28,14 @@ from sketches_tpu import faults, resilience, telemetry
 from sketches_tpu.analysis import registry
 from sketches_tpu.resilience import EngineUnavailable, SpecError
 
-__all__ = ["available", "reset", "NativeDDSketch", "NATIVE_ENV"]
+__all__ = [
+    "available",
+    "reset",
+    "wire_scanner",
+    "NativeDDSketch",
+    "NATIVE_ENV",
+    "WIRE_ABI_VERSION",
+]
 
 #: Environment kill switch: ``SKETCHES_TPU_NATIVE=0`` forces the native
 #: engine unavailable (pure-Python host tier), for degraded-mode CI and
@@ -42,6 +49,16 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libddsketch_host.so")
 _lock = threading.Lock()
 _lib: typing.Optional[ctypes.CDLL] = None
 _build_error: typing.Optional[str] = None
+_wire_ok = False
+
+#: Expected value of the library's ``ddsk_wire_abi_version()`` symbol.
+#: The bulk wire scanner's C ABI (argument layouts, status codes, output
+#: array shapes) is versioned so a STALE ``.so`` -- older sources whose
+#: mtime comparison lied (copied artifacts, clock skew, prebuilt caches)
+#: -- degrades the wire fast path to the pure-Python walker instead of
+#: corrupting decodes through a mismatched layout.  Bump in lockstep
+#: with ``kWireAbiVersion`` in ``native/ddsketch_wire.cpp``.
+WIRE_ABI_VERSION = 1
 
 #: Build/load attempts before the engine degrades for the process, and
 #: the capped exponential backoff between them.  Retries cover transient
@@ -77,7 +94,7 @@ def _stale() -> bool:
         built = os.path.getmtime(_LIB_PATH)
         return any(
             os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > built
-            for f in ("ddsketch_host.cpp", "Makefile")
+            for f in ("ddsketch_host.cpp", "ddsketch_wire.cpp", "Makefile")
         )
     except OSError:
         return False
@@ -93,7 +110,7 @@ def _load() -> typing.Optional[ctypes.CDLL]:
     ``native -> python`` downgrade in ``resilience.health()``, and
     clearable with :func:`reset`.
     """
-    global _lib, _build_error
+    global _lib, _build_error, _wire_ok
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
@@ -124,6 +141,22 @@ def _load() -> typing.Optional[ctypes.CDLL]:
                         text=True,
                     )
                 _lib = _bind(ctypes.CDLL(_LIB_PATH))
+                _wire_ok = _bind_wire(_lib)
+                if not _wire_ok:
+                    # The host-tier engine loaded but the bulk wire
+                    # scanner is missing or speaks a different ABI (a
+                    # stale .so the mtime check could not catch): the
+                    # wire fast path degrades to the pure-Python walker
+                    # while NativeDDSketch stays available.
+                    resilience.record_downgrade(
+                        "native.wire",
+                        "native",
+                        "python",
+                        "wire scanner unavailable: ddsk_wire_abi_version"
+                        f" != {WIRE_ABI_VERSION} or symbols missing"
+                        " (stale/ABI-mismatched library; rebuild with"
+                        " `make -C native`)",
+                    )
                 if _t0 is not None:
                     telemetry.finish_span("native.load_s", _t0)
                 return _lib
@@ -151,10 +184,11 @@ def reset() -> None:
     is fixed.  Live ``NativeDDSketch`` objects keep their own library
     handle and are unaffected.
     """
-    global _lib, _build_error
+    global _lib, _build_error, _wire_ok
     with _lock:
         _lib = None
         _build_error = None
+        _wire_ok = False
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -196,9 +230,81 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _bind_wire(lib: ctypes.CDLL) -> bool:
+    """Declare the bulk wire scanner's C ABI on a loaded handle.
+
+    Returns ``False`` (never raises) when the symbols are absent or the
+    library's ``ddsk_wire_abi_version()`` disagrees with this module's
+    :data:`WIRE_ABI_VERSION` -- a stale or foreign ``.so`` -- or when
+    the host is not little-endian (the scanner memcpys LE wire doubles
+    verbatim).  Argtypes are declared BEFORE the version call so a
+    mismatched library is never entered with an unchecked signature.
+    """
+    import sys
+
+    if sys.byteorder != "little":  # pragma: no cover - LE hosts only
+        return False
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64 = ctypes.c_longlong
+    i64p = ctypes.POINTER(ctypes.c_longlong)
+    dp = ctypes.POINTER(ctypes.c_double)
+    try:
+        lib.ddsk_wire_abi_version.restype = ctypes.c_int
+        lib.ddsk_wire_abi_version.argtypes = []
+        lib.ddsk_wire_scan_dense.restype = i64
+        lib.ddsk_wire_scan_dense.argtypes = [
+            ctypes.c_char_p, i64, i64p,      # buf, n, offsets
+            ctypes.c_char_p, i64,            # prefix, prefix_len
+            i64,                             # base
+            u8p, dp, i64p, i64p, i64p, dp,   # status, zc, pos, len, j0, out
+        ]
+        lib.ddsk_wire_scan_envelope.restype = i64
+        lib.ddsk_wire_scan_envelope.argtypes = [
+            ctypes.c_char_p, i64, i64p,      # buf, n, offsets
+            i64,                             # expected_backend
+            u8p, i64p, i64p, i64p,           # status, level, dense off/len
+        ]
+        lib.ddsk_wire_scan_moment.restype = i64
+        lib.ddsk_wire_scan_moment.argtypes = [
+            ctypes.c_char_p, i64, i64p,      # buf, n, offsets
+            i64, i64,                        # expected_backend, k
+            u8p, dp, dp, dp,                 # status, scalars, powers, logs
+        ]
+    except AttributeError:
+        return False
+    return lib.ddsk_wire_abi_version() == WIRE_ABI_VERSION
+
+
 def available() -> bool:
     """True iff the native engine can be built/loaded on this machine."""
     return _load() is not None
+
+
+def wire_scanner() -> typing.Optional[ctypes.CDLL]:
+    """The wire-scan-capable native library handle, or ``None``.
+
+    The bulk decoders (``pb/wire.py``, ``backends/wirefmt.py``) call
+    this before taking the C++ structural-scan fast path.  Failure
+    modes: returns ``None`` -- never raises -- when the library cannot
+    build/load, when ``SKETCHES_TPU_NATIVE=0`` disables the engine, or
+    when the loaded ``.so`` predates (or postdates) this module's wire
+    ABI (:data:`WIRE_ABI_VERSION` vs the versioned
+    ``ddsk_wire_abi_version`` symbol); callers then decode through the
+    pure-Python canonical walker bit-identically, and the degradation is
+    recorded once in ``resilience.health()`` as a ``native.wire``
+    downgrade.  :func:`reset` clears the cached outcome.
+    """
+    if _load() is None:
+        return None
+    return _lib if _wire_ok else None
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
 
 
 def _dptr(a: np.ndarray):

@@ -16,18 +16,28 @@ with group-vectorized numpy:
   over ``to_host_sketches`` (tested byte-for-byte in
   ``tests/test_wire_bulk.py``): same chunk-padded contiguous runs, same
   field order, same proto3 default-skipping.
-* **decode** (:func:`bytes_to_state`): a hand-rolled parser walks each
-  blob's canonical shape (mapping prefix compare + packed run + sint32
-  offset + zeroCount) and records zero-copy ``frombuffer`` views; groups
-  then place as ONE fancy-indexed scatter per run length.  Anything
-  non-canonical -- sparse ``binCounts`` maps, unpacked repeated doubles,
-  foreign field orders, unknown fields -- falls back per-message to the
-  C++ ``FromString`` parser plus a careful scalar placement with
-  identical semantics to ``batched.from_host_sketches`` (out-of-window
-  mass folds into the edge bins with collapse counters).  Negative dense
-  masses stay on the group path: ``_Decoder.flush_groups`` clips them
-  with ``merge_into``-equivalent semantics (mass counted post-clip), so
-  no fallback is needed for them.
+* **decode** (:func:`bytes_to_state`): two interchangeable batch
+  drivers behind one contract.  The **native driver** (r16, default
+  when ``native/libddsketch_host.so`` carries the versioned wire-codec
+  ABI) packs the batch into one buffer and hands the whole canonical
+  walk -- prefix memcmp, store framing, varint/zigzag scanning,
+  zero-padding trim, payload-offset extraction -- to ONE
+  ``ddsk_wire_scan_dense`` call (``native/ddsketch_wire.cpp``), then
+  group-scatters the returned (offset, length, window-start) arrays in
+  numpy.  The **pure-Python driver** walks each blob with the
+  hand-rolled parser plus a structural-template memo; it is both the
+  fallback tier (no toolchain, ``SKETCHES_TPU_NATIVE=0``, stale ``.so``)
+  and the semantic oracle the native driver is differential-fuzzed
+  against.  Either way, anything non-canonical -- sparse ``binCounts``
+  maps, unpacked repeated doubles, foreign field orders, unknown
+  fields, damaged bytes -- falls back per-message to the C++
+  ``FromString`` parser plus a careful scalar placement with identical
+  semantics to ``batched.from_host_sketches`` (out-of-window mass folds
+  into the edge bins with collapse counters), so both drivers produce
+  bit-identical states and record-identical quarantine reports.
+  Negative dense masses stay on the group path: ``place_block`` clips
+  them with ``merge_into``-equivalent semantics (mass counted
+  post-clip), so no fallback is needed for them.
 
 Mapping gates are shared with ``pb.proto.KeyMappingProto``: LINEAR foreign
 bytes refuse by default, unknown enum values raise, NONE/QUADRATIC/CUBIC
@@ -303,12 +313,9 @@ class _Decoder:
         self.mapping_cache: dict = {}
 
     def flush_groups(self) -> None:
-        arrs = (self.bins_pos, self.bins_neg)
-        nb = self.n_bins
         for (which, ln), items in self.groups.items():
             if not items:
                 continue
-            arr = arrs[which]
             k = len(items)
             idx = np.fromiter((it[0] for it in items), np.int64, k)
             j0s = np.fromiter((it[1] for it in items), np.int64, k)
@@ -318,41 +325,52 @@ class _Decoder:
             block = np.frombuffer(
                 b"".join([it[2] for it in items]), np.float64
             ).reshape(k, ln)
-            if block.min() < 0.0:
-                # Dense entries place only when strictly positive
-                # (StoreProto.merge_into) and mass counts post-clip.
-                block = np.clip(block, 0.0, None)
-            self.count[idx] += block.sum(axis=1)
-            easy = (j0s >= 0) & (j0s + ln <= nb)
-            e = np.nonzero(easy)[0]
-            # Scatter per group, in bounded row chunks: stream rows are
-            # unique within a (store, length) group, so fancy += cannot
-            # collide, and chunking keeps the advanced-indexing broadcast
-            # temps recycled instead of faulting fresh GBs.
-            cstep = max(1, (1 << 23) // max(ln, 1))
-            lane = np.arange(ln)
-            for s in range(0, e.size, cstep):
-                es = e[s : s + cstep]
-                arr[idx[es][:, None], j0s[es][:, None] + lane] += block[es]
-            for h in np.nonzero(~easy)[0]:
-                # Foreign-shaped run overlapping/outside the window: fold
-                # the overhangs into the edge bins with collapse counters.
-                i, j0 = int(idx[h]), int(j0s[h])
-                row = block[h]
-                lo_cut = max(0, -j0)
-                hi_cut = max(0, min(ln, nb - j0))
-                if lo_cut:
-                    low = float(row[:lo_cut].sum())
-                    arr[i, 0] += low
-                    self.clow[i] += low
-                if hi_cut < ln:
-                    high = float(row[hi_cut:].sum())
-                    arr[i, nb - 1] += high
-                    self.chigh[i] += high
-                if hi_cut > lo_cut:
-                    arr[i, j0 + lo_cut : j0 + hi_cut] += row[lo_cut:hi_cut]
+            self.place_block(which, idx, j0s, block, ln)
         self.groups = {}
         self.pending_bytes = 0
+
+    def place_block(self, which, idx, j0s, block, ln: int) -> None:
+        """Place one same-length group block ``[k, ln]`` into store
+        ``which`` (0 = positive, 1 = negative).  The single placement
+        authority for both parse paths: the pure-Python group flush and
+        the native scanner feed it identical payload doubles, so the
+        resulting states are bit-identical by construction.  Stream rows
+        must be unique within the block (one canonical run per (stream,
+        store)), so the fancy ``+=`` cannot collide."""
+        arr = (self.bins_pos, self.bins_neg)[which]
+        nb = self.n_bins
+        if block.min() < 0.0:
+            # Dense entries place only when strictly positive
+            # (StoreProto.merge_into) and mass counts post-clip.
+            block = np.clip(block, 0.0, None)
+        self.count[idx] += block.sum(axis=1)
+        easy = (j0s >= 0) & (j0s + ln <= nb)
+        e = np.nonzero(easy)[0]
+        # Scatter in bounded row chunks: chunking keeps the
+        # advanced-indexing broadcast temps recycled instead of
+        # faulting fresh GBs.
+        cstep = max(1, (1 << 23) // max(ln, 1))
+        lane = np.arange(ln)
+        for s in range(0, e.size, cstep):
+            es = e[s : s + cstep]
+            arr[idx[es][:, None], j0s[es][:, None] + lane] += block[es]
+        for h in np.nonzero(~easy)[0]:
+            # Foreign-shaped run overlapping/outside the window: fold
+            # the overhangs into the edge bins with collapse counters.
+            i, j0 = int(idx[h]), int(j0s[h])
+            row = block[h]
+            lo_cut = max(0, -j0)
+            hi_cut = max(0, min(ln, nb - j0))
+            if lo_cut:
+                low = float(row[:lo_cut].sum())
+                arr[i, 0] += low
+                self.clow[i] += low
+            if hi_cut < ln:
+                high = float(row[hi_cut:].sum())
+                arr[i, nb - 1] += high
+                self.chigh[i] += high
+            if hi_cut > lo_cut:
+                arr[i, j0 + lo_cut : j0 + hi_cut] += row[lo_cut:hi_cut]
 
     def careful_message(self, i: int, msg, assume_native_linear: bool) -> None:
         from sketches_tpu.pb.proto import KeyMappingProto
@@ -566,6 +584,82 @@ class _Template:
         return pending, zc
 
 
+def _scan_dense_native(scanner, blobs, expected_mapping: bytes, base: int,
+                       status: np.ndarray):
+    """One C++ structural scan over the packed batch.
+
+    Packs ``blobs`` into a single buffer, hands the canonical walk
+    (prefix memcmp, store framing, varint/zigzag decode, zero-padding
+    trim) to ``ddsk_wire_scan_dense``, and returns the per-blob fact
+    arrays plus the aligned payload staging buffer.  ``status`` entries
+    nonzero on entry are skipped by the scanner (pre-marked admission
+    failures); on return nonzero entries are the careful-path handoffs.
+    """
+    from sketches_tpu.native import _dptr, _i64ptr, _u8ptr
+
+    n = len(blobs)
+    lens = np.fromiter((len(b) for b in blobs), np.int64, n)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = b"".join(blobs)
+    zc = np.zeros(n, np.float64)
+    run_pos = np.zeros(2 * n, np.int64)
+    run_len = np.zeros(2 * n, np.int64)
+    run_j0 = np.zeros(2 * n, np.int64)
+    payload = np.empty(max(1, len(buf) // 8), np.float64)
+    n_careful = scanner.ddsk_wire_scan_dense(
+        buf, n, _i64ptr(offsets), expected_mapping, len(expected_mapping),
+        base, _u8ptr(status), _dptr(zc), _i64ptr(run_pos),
+        _i64ptr(run_len), _i64ptr(run_j0), _dptr(payload),
+    )
+    if n_careful < 0:  # defensive: the scanner refused its arguments
+        status[:] = 1
+        n_careful = n
+    return zc, run_pos, run_len, run_j0, payload, int(n_careful)
+
+
+def _place_native_runs(dec: "_Decoder", ok: np.ndarray, run_pos, run_len,
+                       run_j0, payload: np.ndarray) -> None:
+    """Group-scatter the native scanner's runs through the decoder.
+
+    The same (store, trimmed-length) grouping as the pure-Python flush,
+    but the group block assembles as ONE fancy gather out of the aligned
+    payload staging buffer instead of a join over per-blob memoryviews.
+    Placement goes through ``_Decoder.place_block`` (the single
+    placement authority), chunked so gather temps stay bounded.
+    """
+    n = ok.shape[0]
+    sel = np.repeat(ok, 2) & (run_len > 0)
+    if not sel.any():
+        return
+    stream2 = np.repeat(np.arange(n, dtype=np.int64), 2)
+    neg2 = np.tile(np.array([False, True]), n)
+    for which in (0, 1):
+        m = sel & (neg2 if which else ~neg2)
+        if not m.any():
+            continue
+        idx = stream2[m]
+        j0s = run_j0[m]
+        lens = run_len[m]
+        pos = run_pos[m]
+        # One stable sort groups the runs by trimmed length (cheaper
+        # than a boolean scan per distinct length when lengths spread).
+        order = np.argsort(lens, kind="stable")
+        lens = lens[order]
+        bounds = np.nonzero(np.diff(lens))[0] + 1
+        starts = np.concatenate(([0], bounds))
+        stops = np.concatenate((bounds, [lens.size]))
+        for a, b in zip(starts.tolist(), stops.tolist()):
+            g = order[a:b]
+            ln = int(lens[a])
+            lane = np.arange(ln)
+            rstep = max(1, (1 << 23) // ln)
+            for s in range(0, g.size, rstep):
+                gs = g[s : s + rstep]
+                block = payload[pos[gs][:, None] + lane]
+                dec.place_block(which, idx[gs], j0s[gs], block, ln)
+
+
 def _quarantine_kind(exc: BaseException) -> str:
     """Stable reason slug for one quarantined blob's failure."""
     if isinstance(exc, BlobTooLarge):
@@ -577,6 +671,161 @@ def _quarantine_kind(exc: BaseException) -> str:
     if isinstance(exc, ValueError):
         return "invalid"
     return "error"
+
+
+def _careful_blob(dec: "_Decoder", i: int, blob: bytes,
+                  assume_native_linear: bool, report) -> None:
+    """One blob through the protobuf reference path (shared by both batch
+    drivers).  Quarantine admission: every raiser -- ``FromString``'s
+    DecodeError, the mapping gates -- fires BEFORE any placement into the
+    decode arrays, so a quarantined stream's row stays exactly empty."""
+    if report is None:
+        dec.careful_message(
+            i, pb.DDSketch.FromString(blob), assume_native_linear
+        )
+    else:
+        try:
+            dec.careful_message(
+                i, pb.DDSketch.FromString(blob), assume_native_linear
+            )
+        except Exception as e:
+            report.add(i, _quarantine_kind(e), e)
+
+
+def _decode_batch_python(dec: "_Decoder", blobs, expected_mapping: bytes,
+                         base: int, fast_ok: bool,
+                         assume_native_linear: bool, report,
+                         max_blob_bytes: Optional[int]) -> None:
+    """The pure-Python batch driver: per-blob canonical walk with the
+    structural-template memo, group staging with incremental flushes, and
+    per-blob careful fallback.  This is the fallback tier when the native
+    scanner is unavailable (no toolchain, ``SKETCHES_TPU_NATIVE=0``,
+    stale/ABI-mismatched ``.so``) -- and the semantic oracle the native
+    driver is differential-tested against."""
+    mlen = len(expected_mapping)
+    zeros: list = []  # (stream, zeroCount) -- vector-assigned at the end
+    templates: dict = {}  # blob length -> _Template
+    for i, blob in enumerate(blobs):
+        if faults._ACTIVE:
+            # Injected blob corruption (deterministic per index) -- the
+            # quarantine path must then catch what it produces.
+            blob = faults.inject(faults.WIRE_BLOB, payload=blob, index=i)
+        if max_blob_bytes is not None and len(blob) > max_blob_bytes:
+            exc = BlobTooLarge(
+                f"blob {i}: {len(blob)} bytes exceeds"
+                f" max_blob_bytes={max_blob_bytes}"
+            )
+            if report is None:
+                raise exc
+            report.add(i, "over_limit", exc)
+            continue
+        parsed = None
+        if fast_ok and blob.startswith(expected_mapping):
+            t = templates.get(len(blob))
+            if t is not None:
+                parsed = t.extract(blob, i, base)
+            if parsed is None:
+                # IndexError backstop: a malformed varint whose
+                # continuation bits run off the blob end must land on the
+                # careful path (DecodeError), not escape as IndexError.
+                try:
+                    full = _parse_canonical(blob, mlen, i, base)
+                except IndexError:
+                    full = None
+                if full is not None:
+                    pending_f, zc_f, positions, zc_pos = full
+                    parsed = (pending_f, zc_f)
+                    if t is None:
+                        templates[len(blob)] = _Template(
+                            blob, mlen, positions, zc_pos
+                        )
+        if parsed is None:
+            _careful_blob(dec, i, blob, assume_native_linear, report)
+            continue
+        pending, zc = parsed
+        groups = dec.groups
+        for key, entry in pending:
+            g = groups.get(key)
+            if g is None:
+                g = groups[key] = []
+            g.append(entry)
+            dec.pending_bytes += key[1] << 3
+        if zc:
+            zeros.append((i, zc))
+        if dec.pending_bytes >= dec._FLUSH_BYTES:
+            dec.flush_groups()
+    if zeros:
+        zi = np.fromiter((z[0] for z in zeros), np.int64, len(zeros))
+        zv = np.fromiter((z[1] for z in zeros), np.float64, len(zeros))
+        dec.zero[zi] = zv
+        dec.count[zi] += zv
+
+
+def _decode_batch_native(scanner, dec: "_Decoder", blobs,
+                         expected_mapping: bytes, base: int,
+                         assume_native_linear: bool, report,
+                         max_blob_bytes: Optional[int]) -> None:
+    """The native batch driver: one C++ structural scan over the packed
+    batch, vectorized group placement, then the careful-path handoffs in
+    batch order.
+
+    Decodes bit-identically to :func:`_decode_batch_python` by
+    construction: fast-parsed blobs yield the identical payload doubles /
+    window starts / zero counts (the scanner mirrors
+    ``_parse_canonical``) placed by the same ``place_block`` authority,
+    and careful blobs take the identical per-blob protobuf path in the
+    identical order, so error types, quarantine records, and admission
+    checks line up record-for-record.
+    """
+    blob_list = list(blobs)
+    n = len(blob_list)
+    if faults._ACTIVE:
+        # Injected blob corruption fires before packing, so the scanner
+        # sees exactly the bytes the pure-Python driver would (the
+        # injection is deterministic per index) and the fault lands on
+        # the careful/quarantine path through the native scan.
+        blob_list = [
+            faults.inject(faults.WIRE_BLOB, payload=b, index=i)
+            for i, b in enumerate(blob_list)
+        ]
+    status = np.zeros(n, np.uint8)
+    if max_blob_bytes is not None:
+        lens = np.fromiter((len(b) for b in blob_list), np.int64, n)
+        status[lens > max_blob_bytes] = 3  # admission failure: pre-marked
+    zc, run_pos, run_len, run_j0, payload, n_careful = _scan_dense_native(
+        scanner, blob_list, expected_mapping, base, status,
+    )
+    if telemetry._ACTIVE:
+        telemetry.counter_inc("wire.native.decode_calls")
+        if n_careful:
+            telemetry.counter_inc(
+                "wire.native.careful_fallbacks", float(n_careful)
+            )
+            misses = int((status == 2).sum())
+            if misses:
+                telemetry.counter_inc(
+                    "wire.native.template_miss", float(misses)
+                )
+    ok = status == 0
+    oki = np.nonzero(ok)[0]
+    zsel = oki[zc[oki] != 0.0]
+    dec.zero[zsel] = zc[zsel]
+    dec.count[zsel] += zc[zsel]
+    _place_native_runs(dec, ok, run_pos, run_len, run_j0, payload)
+    if not n_careful:
+        return
+    for i in np.nonzero(status)[0].tolist():
+        blob = blob_list[i]
+        if status[i] == 3:  # over the admission cap
+            exc = BlobTooLarge(
+                f"blob {i}: {len(blob)} bytes exceeds"
+                f" max_blob_bytes={max_blob_bytes}"
+            )
+            if report is None:
+                raise exc
+            report.add(i, "over_limit", exc)
+            continue
+        _careful_blob(dec, i, blob, assume_native_linear, report)
 
 
 def bytes_to_state(
@@ -627,7 +876,6 @@ def bytes_to_state(
     report = QuarantineReport(total=len(blobs)) if errors == "quarantine" else None
     dec = _Decoder(spec, len(blobs))
     expected_mapping = _mapping_field(spec)
-    mlen = len(expected_mapping)
     # A canonical-prefix match normally certifies the spec's own mapping;
     # for a LINEAR spec it cannot distinguish native bytes from a foreign
     # emitter that happens to share the serialization, so the refusal gate
@@ -637,76 +885,21 @@ def bytes_to_state(
         and not assume_native_linear
     )
     base = spec.key_offset
-    zeros: list = []  # (stream, zeroCount) -- vector-assigned at the end
-    templates: dict = {}  # blob length -> _Template
-    for i, blob in enumerate(blobs):
-        if faults._ACTIVE:
-            # Injected blob corruption (deterministic per index) -- the
-            # quarantine path must then catch what it produces.
-            blob = faults.inject(faults.WIRE_BLOB, payload=blob, index=i)
-        if max_blob_bytes is not None and len(blob) > max_blob_bytes:
-            exc = BlobTooLarge(
-                f"blob {i}: {len(blob)} bytes exceeds"
-                f" max_blob_bytes={max_blob_bytes}"
-            )
-            if report is None:
-                raise exc
-            report.add(i, "over_limit", exc)
-            continue
-        parsed = None
-        if fast_ok and blob.startswith(expected_mapping):
-            t = templates.get(len(blob))
-            if t is not None:
-                parsed = t.extract(blob, i, base)
-            if parsed is None:
-                # IndexError backstop: a malformed varint whose
-                # continuation bits run off the blob end must land on the
-                # careful path (DecodeError), not escape as IndexError.
-                try:
-                    full = _parse_canonical(blob, mlen, i, base)
-                except IndexError:
-                    full = None
-                if full is not None:
-                    pending_f, zc_f, positions, zc_pos = full
-                    parsed = (pending_f, zc_f)
-                    if t is None:
-                        templates[len(blob)] = _Template(
-                            blob, mlen, positions, zc_pos
-                        )
-        if parsed is None:
-            if report is None:
-                dec.careful_message(
-                    i, pb.DDSketch.FromString(blob), assume_native_linear
-                )
-            else:
-                # Quarantine admission: every raiser on this path
-                # (FromString's DecodeError, the mapping gates) fires
-                # BEFORE any placement into the decode arrays, so a
-                # quarantined stream's row stays exactly empty.
-                try:
-                    dec.careful_message(
-                        i, pb.DDSketch.FromString(blob), assume_native_linear
-                    )
-                except Exception as e:
-                    report.add(i, _quarantine_kind(e), e)
-            continue
-        pending, zc = parsed
-        groups = dec.groups
-        for key, entry in pending:
-            g = groups.get(key)
-            if g is None:
-                g = groups[key] = []
-            g.append(entry)
-            dec.pending_bytes += key[1] << 3
-        if zc:
-            zeros.append((i, zc))
-        if dec.pending_bytes >= dec._FLUSH_BYTES:
-            dec.flush_groups()
-    if zeros:
-        zi = np.fromiter((z[0] for z in zeros), np.int64, len(zeros))
-        zv = np.fromiter((z[1] for z in zeros), np.float64, len(zeros))
-        dec.zero[zi] = zv
-        dec.count[zi] += zv
+    scanner = None
+    if fast_ok and len(blobs):
+        from sketches_tpu import native
+
+        scanner = native.wire_scanner()
+    if scanner is not None:
+        _decode_batch_native(
+            scanner, dec, blobs, expected_mapping, base,
+            assume_native_linear, report, max_blob_bytes,
+        )
+    else:
+        _decode_batch_python(
+            dec, blobs, expected_mapping, base, fast_ok,
+            assume_native_linear, report, max_blob_bytes,
+        )
     state = dec.finish()
     if integrity._ACTIVE:
         # Guarded seam: invariant-check the decoded batch.  Structurally
@@ -744,7 +937,13 @@ def protos_to_state(
 
     Re-serializing through the C++ serializer (~1 us/message) canonicalizes
     the wire, so the group-vectorized bytes path serves message inputs too
-    (error policy included -- see :func:`bytes_to_state`).
+    (error policy included -- see :func:`bytes_to_state`).  Messages that
+    originated from bytes (the fleet-ingest shape) therefore ride the
+    SAME native offset-extraction fast path as :func:`bytes_to_state`:
+    the round-trip through ``SerializeToString`` re-emits the canonical
+    template the scanner matches, so message inputs inherit the C++
+    structural scan without a second implementation (docs/DESIGN.md
+    section 17).
     """
     return bytes_to_state(
         spec,

@@ -194,6 +194,16 @@ _DECLARED = (
            "Wire blobs admitted to bytes_to_state (quarantined included)."),
     Metric("wire.blobs_quarantined", "counter", "sketches_tpu.pb.wire",
            "Blobs isolated by a quarantine-mode bulk decode."),
+    Metric("wire.native.decode_calls", "counter", "sketches_tpu.pb.wire",
+           "Bulk decode batches scanned by the native C++ structural"
+           " codec (dense scans and envelope splits both count)."),
+    Metric("wire.native.careful_fallbacks", "counter",
+           "sketches_tpu.pb.wire",
+           "Blobs the native scanner handed back to the per-blob Python"
+           " careful path (foreign, damaged, or pre-marked blobs)."),
+    Metric("wire.native.template_miss", "counter", "sketches_tpu.pb.wire",
+           "Careful handoffs whose canonical mapping prefix matched but"
+           " whose structure deviated from the template shape."),
     Metric("native.load_attempts", "counter", "sketches_tpu.native",
            "Native-engine build/load attempts (retries included)."),
     Metric("resilience.downgrade", "counter", "sketches_tpu.resilience",

@@ -498,3 +498,336 @@ def test_decode_rejects_mapping_mismatch():
     )
     with pytest.raises(UnequalSketchParametersError):
         batched_from_bytes(spec, [blob])
+
+
+# ---------------------------------------------------------------------------
+# Native bulk codec (r16): the C++ structural scanner must decode
+# bit-identically to the pure-Python canonical walker -- states, error
+# types, quarantine records -- on everything, including SketchPayload
+# envelopes and injected wire faults.
+# ---------------------------------------------------------------------------
+
+
+def _wire_scanner_ready() -> bool:
+    from sketches_tpu import native
+
+    return native.wire_scanner() is not None
+
+
+needs_native_wire = pytest.mark.skipif(
+    not _wire_scanner_ready(),
+    reason="native wire scanner unavailable (no toolchain or disabled)",
+)
+
+
+class _python_wire_path:
+    """Context manager forcing the pure-Python walker (the native
+    scanner reports unavailable for the duration)."""
+
+    def __enter__(self):
+        from sketches_tpu import native
+
+        self._orig = native.wire_scanner
+        native.wire_scanner = lambda: None
+        return self
+
+    def __exit__(self, *exc):
+        from sketches_tpu import native
+
+        native.wire_scanner = self._orig
+        return False
+
+
+def _both_paths(fn):
+    """Run ``fn()`` through the native path and the pure-Python path ->
+    ((result, error), (result, error))."""
+    try:
+        nat = (fn(), None)
+    except Exception as e:  # noqa: BLE001 - differential harness
+        nat = (None, e)
+    with _python_wire_path():
+        try:
+            py = (fn(), None)
+        except Exception as e:  # noqa: BLE001 - differential harness
+            py = (None, e)
+    return nat, py
+
+
+@needs_native_wire
+@pytest.mark.parametrize("spec", SPECS, ids=lambda s: f"{s.mapping_name}-{s.n_bins}")
+def test_native_decode_matches_python_bit_identical(spec):
+    st = _mixed_state(spec, 64, seed=17)
+    blobs = batched_to_bytes(spec, st)
+    (nat, ne), (py, pe) = _both_paths(lambda: batched_from_bytes(spec, blobs))
+    assert ne is None and pe is None
+    _assert_states_equal(nat, py)
+
+
+@needs_native_wire
+def test_native_decode_recentered_and_foreign_shapes():
+    """Per-stream drifted offsets (every store offset differs) plus
+    foreign sparse/unpacked blobs interleaved: native must place the
+    canonical majority and hand the foreign minority to the identical
+    careful path."""
+    from tests.test_wire import ddsketch_bytes, index_mapping_bytes, store_bytes
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=256)
+    st = _mixed_state(spec, 32, seed=3, with_empty=False)
+    st = recenter(
+        spec, st, st.key_offset + jnp.arange(32, dtype=jnp.int32) * 5 - 60
+    )
+    blobs = list(batched_to_bytes(spec, st))
+    GAMMA = (1 + 0.02) / (1 - 0.02)
+    blobs.insert(
+        7,
+        ddsketch_bytes(  # sparse map + zero count: careful-path handoff
+            index_mapping_bytes(GAMMA, 0),
+            pos=store_bytes(bin_counts={-500: 2.0, 0: 1.0, 500: 3.0}),
+            zero_count=4.0,
+        ),
+    )
+    blobs.insert(
+        20,
+        ddsketch_bytes(  # unpacked repeated doubles: careful-path handoff
+            index_mapping_bytes(GAMMA, 0),
+            pos=store_bytes(contiguous=[2.0, 3.0], offset=9, packed=False),
+        ),
+    )
+    (nat, ne), (py, pe) = _both_paths(lambda: batched_from_bytes(spec, blobs))
+    assert ne is None and pe is None
+    _assert_states_equal(nat, py)
+
+
+@needs_native_wire
+def test_native_differential_fuzz_mutations():
+    """Differential fuzz, native vs pure-Python: mutated canonical blobs
+    must produce the identical state where both parse and the same error
+    type where either refuses -- the native scanner may only ever be
+    MORE conservative (careful handoff), never differently lenient."""
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 8, seed=43, with_empty=False)
+    blobs = batched_to_bytes(spec, st)
+    rng = np.random.RandomState(4242)
+    checked_ok = checked_raise = 0
+    for trial in range(160):
+        blob = bytearray(blobs[trial % len(blobs)])
+        op = trial % 4
+        if op == 0:  # flip a random byte
+            i = rng.randint(len(blob))
+            blob[i] ^= 1 << rng.randint(8)
+        elif op == 1:  # truncate
+            blob = blob[: rng.randint(1, len(blob))]
+        elif op == 2:  # corrupt a varint-ish region near a boundary
+            i = rng.randint(min(32, len(blob)))
+            blob[i] = 0x80 | blob[i]
+        else:  # splice two blobs (length lies)
+            other = blobs[(trial + 1) % len(blobs)]
+            cut = rng.randint(1, len(blob))
+            blob = blob[:cut] + other[cut:]
+        batch = [bytes(blob), blobs[0]]  # a clean blob rides along
+        (nat, ne), (py, pe) = _both_paths(
+            lambda: batched_from_bytes(spec, batch)
+        )
+        if pe is not None:
+            assert ne is not None, f"native accepted what python refused: {bytes(blob).hex()}"
+            assert type(ne) is type(pe), (ne, pe)
+            checked_raise += 1
+        else:
+            assert ne is None, f"native refused what python accepted: {ne}"
+            _assert_states_equal(nat, py)
+            checked_ok += 1
+    assert checked_ok > 20 and checked_raise > 20, (checked_ok, checked_raise)
+
+
+@needs_native_wire
+def test_native_quarantine_report_parity():
+    """errors='quarantine' through the native scanner: the same records
+    (index + structured reason) and the same surviving state as the
+    pure-Python path, bit for bit."""
+    from sketches_tpu.pb.wire import bytes_to_state
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 256, seed=23)
+    blobs = list(batched_to_bytes(spec, st))
+    rng = np.random.RandomState(99)
+    for i in range(0, 256, 17):  # deterministic corruption sites
+        b = bytearray(blobs[i])
+        b[rng.randint(len(b))] ^= 0xFF
+        blobs[i] = bytes(b[: rng.randint(1, len(b))] if i % 2 else b)
+    blobs[5] = b"\x00" * 4096  # garbage; also the over-limit candidate
+
+    def decode():
+        return bytes_to_state(
+            spec, blobs, errors="quarantine", max_blob_bytes=2048
+        )
+
+    (nat, ne), (py, pe) = _both_paths(decode)
+    assert ne is None and pe is None
+    nstate, nreport = nat
+    pstate, preport = py
+    _assert_states_equal(nstate, pstate)
+    assert [(r.index, r.kind) for r in nreport.records] == [
+        (r.index, r.kind) for r in preport.records
+    ]
+    assert nreport.n_quarantined > 0
+    assert any(r.kind == "over_limit" for r in nreport.records)
+
+
+@needs_native_wire
+def test_native_oversized_blob_raises_like_python():
+    from sketches_tpu.pb.wire import bytes_to_state
+    from sketches_tpu.resilience import BlobTooLarge
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 4, seed=2, with_empty=False)
+    blobs = batched_to_bytes(spec, st)
+    cap = max(len(b) for b in blobs) - 1
+
+    def decode():
+        return bytes_to_state(spec, blobs, max_blob_bytes=cap)
+
+    (nat, ne), (py, pe) = _both_paths(decode)
+    assert isinstance(ne, BlobTooLarge) and isinstance(pe, BlobTooLarge)
+    assert str(ne) == str(pe)
+
+
+@needs_native_wire
+def test_native_wire_fault_site_fires_through_scanner():
+    """The wire.blob fault site is injected BEFORE the native pack, so
+    the deterministic corruption lands on the scanner's careful path and
+    quarantine catches exactly what the pure-Python path catches."""
+    from sketches_tpu import faults
+    from sketches_tpu.pb.wire import bytes_to_state
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 64, seed=31, with_empty=False)
+    blobs = batched_to_bytes(spec, st)
+
+    def decode():
+        with faults.active(
+            {"wire.blob": {"fraction": 0.2, "seed": 5, "mode": "corrupt"}}
+        ) as plans:
+            out = bytes_to_state(spec, blobs, errors="quarantine")
+            assert plans["wire.blob"].fired > 0
+            return out
+
+    (nat, ne), (py, pe) = _both_paths(decode)
+    assert ne is None and pe is None
+    nstate, nreport = nat
+    pstate, preport = py
+    _assert_states_equal(nstate, pstate)
+    assert [(r.index, r.kind) for r in nreport.records] == [
+        (r.index, r.kind) for r in preport.records
+    ]
+
+
+@needs_native_wire
+@pytest.mark.parametrize("backend", ["uniform_collapse", "moment"])
+def test_native_envelope_parity(backend):
+    """SketchPayload envelopes route through the native scanner: decoded
+    backend states must match the pure-Python walk field for field, and
+    a corrupted/forged envelope must raise the same refusal."""
+    from sketches_tpu.backends import facade_for
+    from sketches_tpu.backends.wirefmt import payload_from_bytes, payload_to_bytes
+
+    if backend == "uniform_collapse":
+        spec = SketchSpec(relative_accuracy=0.01, n_bins=128, backend=backend)
+    else:
+        spec = SketchSpec(relative_accuracy=0.01, backend=backend)
+    sk = facade_for(6, spec=spec)
+    rng = np.random.RandomState(11)
+    sk.add(rng.lognormal(1.0, 2.0, (6, 512)).astype(np.float32))
+    blobs = payload_to_bytes(spec, sk.state)
+    assert all(b[:1] == b"\x08" for b in blobs)
+
+    (nat, ne), (py, pe) = _both_paths(lambda: payload_from_bytes(spec, blobs))
+    assert ne is None and pe is None
+    import jax
+
+    nl = jax.tree_util.tree_leaves(nat)
+    pl = jax.tree_util.tree_leaves(py)
+    assert len(nl) == len(pl) and len(nl) > 0
+    for a, b in zip(nl, pl):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Structural damage and backend forgery refuse identically.
+    from sketches_tpu.resilience import WireDecodeError
+
+    for bad in (blobs[0][: len(blobs[0]) // 2], b"\x08\x63" + blobs[0][2:]):
+        batch = [blobs[1], bad]
+        (rn, en), (rp, ep) = _both_paths(
+            lambda: payload_from_bytes(spec, batch)
+        )
+        assert isinstance(ep, WireDecodeError), ep
+        assert type(en) is type(ep)
+        assert str(en) == str(ep)
+
+
+@needs_native_wire
+def test_native_envelope_level_gate_message_parity():
+    """A canonical envelope whose level fails the range gate must refuse
+    with the exact pure-Python message (the native split reports the
+    level, Python formats the refusal)."""
+    from sketches_tpu.backends import facade_for
+    from sketches_tpu.backends.wirefmt import payload_from_bytes, payload_to_bytes
+
+    spec = SketchSpec(
+        relative_accuracy=0.01, n_bins=128, backend="uniform_collapse"
+    )
+    sk = facade_for(2, spec=spec)
+    sk.add(np.ones((2, 8), np.float32))
+    blobs = list(payload_to_bytes(spec, sk.state))
+    # Forge an out-of-range level on the trailing field-3 varint.
+    assert blobs[1].endswith(b"\x18\x00")
+    blobs[1] = blobs[1][:-1] + bytes([spec.max_collapses + 1])
+    (rn, en), (rp, ep) = _both_paths(lambda: payload_from_bytes(spec, blobs))
+    assert en is not None and ep is not None
+    assert type(en) is type(ep) and str(en) == str(ep)
+
+
+@needs_native_wire
+def test_native_telemetry_counters_observe_hit_rate():
+    from sketches_tpu import telemetry
+    from sketches_tpu.pb.wire import bytes_to_state
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 16, seed=51, with_empty=False)
+    blobs = list(batched_to_bytes(spec, st))
+    blobs[3] = b"\x00garbage"
+    telemetry.reset()
+    telemetry.enable(True)
+    try:
+        bytes_to_state(spec, blobs, errors="quarantine")
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.enable(False)
+        telemetry.reset()
+    counters = snap["counters"]
+    assert counters.get("wire.native.decode_calls", 0) >= 1
+    assert counters.get("wire.native.careful_fallbacks", 0) >= 1
+
+
+def test_stale_wire_abi_degrades_to_python():
+    """A library without the versioned wire symbols (or with a foreign
+    ABI version) must yield wire_scanner() is None -- decode then rides
+    the pure-Python walker bit-identically, never a corrupted layout."""
+    from sketches_tpu import native
+
+    class _HostOnlyLib:
+        def __getattr__(self, name):  # every symbol lookup misses
+            raise AttributeError(name)
+
+    assert native._bind_wire(_HostOnlyLib()) is False
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    st = _mixed_state(spec, 8, seed=61)
+    blobs = batched_to_bytes(spec, st)
+    ref = batched_from_bytes(spec, blobs)
+    orig = native._wire_ok
+    try:
+        native._wire_ok = False  # simulate the stale-.so outcome
+        assert native.wire_scanner() is None
+        degraded = batched_from_bytes(spec, blobs)
+    finally:
+        native._wire_ok = orig
+    _assert_states_equal(ref, degraded)
