@@ -468,6 +468,15 @@ def _device_bench(
     total = float(_sync(state.count.sum()))
     out = {
         "engine": "pallas" if use_pallas else "xla",
+        # The construction rung the unit-weight kernel adds resolved to
+        # (satellite 6: a CPU capture was indistinguishable from a TPU
+        # one except by eyeballing the device field -- now the variant
+        # stamps the capture class).
+        "ingest_variant": (
+            kernels.choose_ingest_engine(spec, weighted=False)
+            if use_pallas
+            else "xla"
+        ),
         "query_engine": engine_pick,
         "ingest_dispatch_per_s": round(dispatch_per_s, 1),
         "ingest_fused_per_s": round(fused_per_s, 1),
@@ -566,6 +575,129 @@ def bench_1m(profile: bool):
                 floor_subtracted_rate(512)
             )
         return out
+
+
+def bench_ingest_variants(skip_1m: bool = False):
+    """Per-construction-rung ingest decomposition (DESIGN.md 2-r17).
+
+    Three captures per rung in ``kernels.INGEST_VARIANTS``:
+
+    * ``elem_ops_per_value`` -- the static jaxpr construction-width
+      audit (device-independent; the number the CI pin watches).
+    * on TPU: ``fused_floorsub_per_s`` at the letter shape (1M x 512,
+      512-wide unit batches, fused k=4, dispatch floor subtracted) --
+      the §2-r17 verdict number per rung.
+    * off TPU: ``interpret_small_s`` -- interpret-mode wall time at a
+      small shape (stage structure only, NOT a throughput claim) plus
+      ``parity_vs_stock`` (bit-identical histograms+counters), so a
+      CPU-container capture still proves exactness and structure.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sketches_tpu import kernels
+    from sketches_tpu.analysis import jaxpr_audit
+    from sketches_tpu.batched import SketchSpec, init
+
+    spec = SketchSpec(
+        relative_accuracy=0.01, n_bins=512, mapping_name="cubic_interpolated"
+    )
+    on_tpu = jax.default_backend() == "tpu"
+    out = {
+        "default_variant": kernels.choose_ingest_engine(spec, weighted=False),
+        "kill_switch": kernels.INGEST_PACKED_ENV,
+        "variants": {},
+    }
+    for variant in kernels.INGEST_VARIANTS:
+        row = {
+            "elem_ops_per_value_512": round(
+                jaxpr_audit.elem_ops_per_value(variant=variant, n_bins=512), 1
+            )
+        }
+        out["variants"][variant] = row
+
+    if on_tpu and not skip_1m:
+        n, batch, k = 1 << 20, 512, 4
+
+        def floorsub(variant):
+            v = jax.jit(
+                lambda kk: jnp.exp(
+                    1.5 * jax.random.normal(kk, (n, batch), jnp.float32)
+                )
+            )(jax.random.PRNGKey(0))
+            _sync(v[:1, :1])
+            f = jax.jit(
+                lambda s, vv: jax.lax.fori_loop(
+                    0, k,
+                    lambda i, ss: kernels.add(spec, ss, vv, variant=variant),
+                    s,
+                ),
+                donate_argnums=(0,),
+            )
+            st = f(init(spec, n), v)
+            _sync(st.count[:1])
+            del st
+            best = float("inf")
+            for _ in range(3):
+                st = init(spec, n)
+                _sync(st.count[:1])
+                t0 = time.perf_counter()
+                st = f(st, v)
+                _sync(st.count[:1])
+                best = min(best, time.perf_counter() - t0)
+                del st
+            floor = dispatch_floor_s()
+            if best <= floor:
+                return None
+            return round(n * batch * k / (best - floor), 1)
+
+        for variant in kernels.INGEST_VARIANTS:
+            try:
+                out["variants"][variant]["fused_floorsub_per_s"] = floorsub(
+                    variant
+                )
+            except Exception as e:  # a rung that fails to lower is a result
+                out["variants"][variant]["error"] = (
+                    f"{type(e).__name__}: {str(e)[:200]}"
+                )
+    else:
+        # CPU container: interpret-mode structure + exactness parity.
+        n, batch = 256, 256
+        v = jnp.asarray(
+            np.exp(
+                1.5 * np.random.default_rng(0).standard_normal((n, batch))
+            ).astype(np.float32)
+        )
+        w = jnp.ones((n, batch), jnp.float32)
+        ko = init(spec, n).key_offset
+
+        def run(variant):
+            f = jax.jit(
+                functools.partial(
+                    kernels.ingest_histogram, spec,
+                    weighted=False, interpret=True, variant=variant,
+                )
+            )
+            res = f(v, w, ko)
+            _sync(res[0][:1, :1])
+            t0 = time.perf_counter()
+            res = f(v, w, ko)
+            _sync(res[0][:1, :1])
+            return time.perf_counter() - t0, res
+
+        _, ref = run("stock")
+        ref_np = [np.asarray(x) for x in ref]
+        for variant in kernels.INGEST_VARIANTS:
+            dt, res = run(variant)
+            row = out["variants"][variant]
+            row["interpret_small_s"] = round(dt, 4)
+            row["parity_vs_stock"] = bool(
+                all(
+                    np.array_equal(np.asarray(a), b, equal_nan=True)
+                    for a, b in zip(res, ref_np)
+                )
+            )
+    return out
 
 
 def bench_membw(skip_1m: bool = False):
@@ -1381,6 +1513,14 @@ def compact_summary(doc: dict, full_doc_name: str) -> dict:
             for p in fold_scaling.get("curve", [])
             if isinstance(p, dict)
         } or None
+    variants = cfg.get("ingest_variants") or {}
+    # Per-rung floor-subtracted rates (TPU captures) -- the 2-r17 verdict
+    # numbers, compacted to {rung: rate}; None off-TPU.
+    variant_rates = {
+        k: v.get("fused_floorsub_per_s")
+        for k, v in (variants.get("variants") or {}).items()
+        if isinstance(v, dict) and v.get("fused_floorsub_per_s") is not None
+    } or None
     frontier = cfg.get("backend_frontier") or {}
     frontier_compact = {
         k: {
@@ -1402,6 +1542,13 @@ def compact_summary(doc: dict, full_doc_name: str) -> dict:
         "ingest_1m_fused_per_s": (
             cfg.get("c2_c4_1m_streams_cubic_collapsing") or {}
         ).get("ingest_fused_per_s"),
+        "ingest_1m_floorsub_512": (
+            cfg.get("c2_c4_1m_streams_cubic_collapsing") or {}
+        ).get("ingest_fused_per_s_floorsub_batch512"),
+        # Capture-class stamp + per-rung verdicts (satellite 6: the
+        # driver can now refuse cross-variant comparisons by name).
+        "ingest_variant": doc.get("ingest_variant"),
+        "ingest_variant_rates": variant_rates,
         "worst_query": {
             k: worst.get(k)
             for k in (
@@ -1482,6 +1629,7 @@ def main():
     jax_scalar = bench_jax_scalar()
     serde = bench_serde()
     frontier = bench_backend_frontier(args.skip_1m)
+    ingest_variants = bench_ingest_variants(args.skip_1m)
     from sketches_tpu import telemetry
 
     doc = {
@@ -1499,11 +1647,16 @@ def main():
             "c3_distributed": c3,
             "serde_bulk": serde,
             "backend_frontier": frontier,
+            "ingest_variants": ingest_variants,
         },
         "membw_read": membw,
         "verify_pallas_vs_xla_on_device": verify,
         "host_sync_floor_s": sync_floor_s,
         "device": device,
+        # Capture-class stamp (satellite 6): which construction rung the
+        # default unit ingest resolves to in THIS process -- check-bench
+        # refuses cross-variant comparisons by this field.
+        "ingest_variant": ingest_variants["default_variant"],
         # Self-sketching telemetry snapshot of this bench process (empty
         # counters/histograms unless SKETCHES_TPU_TELEMETRY armed it --
         # armed runs measure the armed overhead, so the default stays

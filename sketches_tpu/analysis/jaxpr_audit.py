@@ -43,9 +43,11 @@ from sketches_tpu.analysis.lint import Finding
 
 __all__ = [
     "VMEM_BUDGET_BYTES",
+    "ELEMENTWISE_PRIMS",
     "audit",
     "audit_callable",
     "default_entry_points",
+    "elem_ops_per_value",
     "vmem_report",
 ]
 
@@ -57,6 +59,23 @@ VMEM_BUDGET_BYTES = 16 * 1024 * 1024
 
 _BAD_DTYPES = ("float64", "complex128")
 _CALLBACK_MARKERS = ("callback", "outside_call")
+
+#: The primitives :func:`elem_ops_per_value` counts as one VPU lane-op
+#: per output element: elementwise arithmetic/compare/select/convert --
+#: the construction-width currency of DESIGN.md §2-r5/§2-r17.  Excluded
+#: on purpose: ``dot_general`` (MXU, measured ~8% of the kernel),
+#: ``iota``/``broadcast_in_dim``/layout ops (no arithmetic), and the
+#: ``reduce_*`` family (bookkeeping reductions, not construction rows).
+ELEMENTWISE_PRIMS = frozenset(
+    """
+    add sub mul div neg sign abs floor ceil round rem pow integer_pow
+    max min eq ne lt le gt ge and or not xor nand nor
+    shift_left shift_right_logical shift_right_arithmetic
+    select_n convert_element_type clamp is_finite
+    exp exp2 log log1p expm1 sqrt rsqrt cbrt logistic tanh erf
+    population_count clz bitcast_convert_type
+    """.split()
+)
 
 
 def _iter_jaxprs(jaxpr) -> Iterable:
@@ -164,6 +183,68 @@ def audit_callable(
     return unique
 
 
+def elem_ops_per_value(
+    variant: str = "stock",
+    weighted: bool = False,
+    n_streams: int = 128,
+    n_bins: int = 256,
+    batch: int = 128,
+) -> float:
+    """Static construction-width audit: elementwise VPU lane-ops per
+    ingested value, derived from the traced ingest jaxpr (ISSUE 12
+    satellite 2).
+
+    Traces ``kernels.ingest_histogram`` for the given construction rung
+    and walks every sub-jaxpr (the Pallas kernel body included -- pallas
+    abstract-eval needs no TPU), summing output elements over
+    :data:`ELEMENTWISE_PRIMS` equations and dividing by the ingested
+    value count.  Hardware-independent by construction: the number
+    moves only when the traced formulation's arithmetic width moves, so
+    a test pin on it fails CI on a construction-width regression
+    without waiting for the next TPU bench run.  (The §2-r5 stock
+    budget in these units: (LO + 2·HI) rows × compare+mask+cast ≈ 272+
+    lane-ops/value at 512 bins, keys/masks/bookkeeping included.)
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from sketches_tpu import batched, kernels
+
+    spec = batched.SketchSpec(n_bins=n_bins)
+    state = batched.init(spec, n_streams)
+    values = jnp.zeros((n_streams, batch), jnp.float32)
+    weights = jnp.ones((n_streams, batch), jnp.float32)
+    fn = functools.partial(
+        kernels.ingest_histogram, spec, weighted=weighted, variant=variant
+    )
+    closed = jax.make_jaxpr(fn)(values, weights, state.key_offset)
+    total = 0
+    for sub in _iter_jaxprs(closed.jaxpr):
+        for eqn in sub.eqns:
+            if eqn.primitive.name not in ELEMENTWISE_PRIMS:
+                continue
+            size = 0
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                shape = getattr(aval, "shape", None)
+                if shape is not None:
+                    n = 1
+                    for d in shape:
+                        n *= int(d)
+                    size = max(size, n)
+            total += size
+    # The kernel body traces ONCE at block shapes while the grid replays
+    # it per (stream-block, value-chunk) cell; the default shapes pick
+    # exactly one grid cell (128 streams x 128 values), so the traced
+    # element count IS the executed count and the per-value ratio is
+    # exact.  Cell-invariant hoisted work (identity row, unpack
+    # matrices) is charged to the single cell -- conservative for the
+    # variants, which amortize it across the real grid.
+    return total / float(n_streams * batch)
+
+
 def default_entry_points() -> List[Tuple[str, Callable, Sequence]]:
     """The audited surface: every engine a facade can dispatch to.
 
@@ -201,6 +282,20 @@ def default_entry_points() -> List[Tuple[str, Callable, Sequence]]:
             functools.partial(kernels.ingest_histogram, spec),
             (values, weights, state.key_offset),
         ),
+        # The construction-variant rungs (unit-weight; see
+        # kernels.INGEST_VARIANTS) -- each a distinct audited entry so
+        # profiling's roofline join can name the rung that served.
+        *[
+            (
+                f"kernels.ingest_histogram:{v}",
+                functools.partial(
+                    kernels.ingest_histogram, spec,
+                    weighted=False, variant=v,
+                ),
+                (values, weights, state.key_offset),
+            )
+            for v in kernels.INGEST_VARIANTS[1:]
+        ],
         (
             "kernels.fused_quantile",
             functools.partial(kernels.fused_quantile, spec),

@@ -38,6 +38,7 @@ __all__ = [
     "SERVE_HEDGE",
     "ELASTIC",
     "FLIGHT_RECORDER",
+    "INGEST_PACKED",
     "ADAPTIVE",
     "REGISTRY",
     "declared",
@@ -198,6 +199,19 @@ SERVE_HEDGE = EnvVar(
     ),
 )
 
+#: Packed-ingest-variant kill switch (``sketches_tpu.kernels``).
+INGEST_PACKED = EnvVar(
+    name="SKETCHES_TPU_INGEST_PACKED",
+    default="1",
+    owner="sketches_tpu.kernels",
+    doc=(
+        "Set to 0 to pin the fused ingest kernel to the stock int8"
+        " one-hot construction; facades then never select the packed"
+        " sub-byte / folded construction variants (the measured-dead"
+        " escape hatch for the r17 construction-width rungs)."
+    ),
+)
+
 #: Adaptive-accuracy backend kill switch (``sketches_tpu.backends``).
 ADAPTIVE = EnvVar(
     name="SKETCHES_TPU_ADAPTIVE",
@@ -219,7 +233,7 @@ REGISTRY: Dict[str, EnvVar] = {
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
         ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
-        FLIGHT_RECORDER, ADAPTIVE,
+        FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE,
     )
 }
 

@@ -1047,7 +1047,10 @@ class BatchedDDSketch:
     ``overlap -> tiles -> windowed -> wxla -> xla`` ladder (recorded in
     ``resilience.health()``; only an ``xla``-floor failure re-raises), a
     Pallas ingest failure demotes to the XLA scatter path and replays
-    the batch; empty streams and out-of-range quantiles answer NaN;
+    the batch -- a non-stock ingest construction rung
+    (``kernels.INGEST_VARIANTS``) failing first demotes to the stock
+    rung, also ledger-recorded; empty streams and out-of-range
+    quantiles answer NaN;
     invalid construction raises ``SpecError`` and unequal-spec merges
     raise ``UnequalSketchParametersError``.
     """
@@ -1092,13 +1095,24 @@ class BatchedDDSketch:
         # the non-128-aligned batch widths the kernels do not.
         self._add_xla = functools.partial(add, spec)
         if use_pallas:
+            # One ingest body per construction rung (kernels.INGEST_VARIANTS)
+            # so the jit cache keys on the variant; ``_add_pallas`` is the
+            # stock rung and doubles as the engine-alive flag.
             self._add_pallas = functools.partial(
-                kernels.add, spec, interpret=interpret
+                kernels.add, spec, interpret=interpret, variant="stock"
+            )
+            self._add_pallas_variant = lambda v: functools.partial(
+                kernels.add, spec, interpret=interpret, variant=v
             )
             self._batch_ok = lambda s: kernels.supports(spec, n_streams, s)
         else:
             self._add_pallas = None
+            self._add_pallas_variant = None
             self._batch_ok = lambda s: False
+        # Ingest construction-rung ladder state: a variant lowering failure
+        # demotes this facade to the stock rung for good (recorded in
+        # resilience.health()), mirroring the query ladder's discipline.
+        self._ingest_variant_demoted = False
         # Query engines, fastest-eligible first (see _query_fn):
         # * overlap Pallas kernel -- the tile-list walk with manual
         #   double-buffered async copies (DMA ring + cross-block
@@ -1226,21 +1240,61 @@ class BatchedDDSketch:
             # kernels.add).
             and not (self.spec.bins_integer and weights is not None)
         ):
+            from sketches_tpu import kernels
+
+            variant = (
+                "stock"
+                if self._ingest_variant_demoted
+                else kernels.choose_ingest_engine(
+                    self.spec, weighted=weights is not None
+                )
+            )
             try:
+                # The whole-kernel fault site sits ABOVE the rung ladder:
+                # a pallas.ingest fault means "this engine is gone" and
+                # demotes straight to XLA, whatever rung was selected.
                 if faults._ACTIVE:
                     faults.inject(faults.PALLAS_INGEST)
-                _eng = "pallas"
-                self._stream_op("add_pallas", self._add_pallas, values, weights)
+                if variant != "stock":
+                    # Non-stock construction rung: a lowering/compile
+                    # failure here demotes to the stock rung (health
+                    # ledger), NOT all the way to XLA -- the rungs are
+                    # bit-identical, so the replay is exact (failures
+                    # surface at compile time).
+                    try:
+                        if faults._ACTIVE:
+                            faults.inject(
+                                faults.PALLAS_INGEST_VARIANT, tier=variant
+                            )
+                        _eng = f"pallas:{variant}"
+                        self._stream_op(
+                            f"add_pallas:{variant}",
+                            self._add_pallas_variant(variant),
+                            values, weights,
+                        )
+                    except Exception as ev:
+                        self._ingest_variant_demoted = True
+                        resilience.record_downgrade(
+                            f"{self._health_component}.ingest_variant",
+                            variant, "stock", repr(ev),
+                        )
+                        variant = "stock"
+                if variant == "stock":
+                    _eng = "pallas"
+                    self._stream_op(
+                        "add_pallas", self._add_pallas, values, weights
+                    )
             except Exception as e:
-                # Pallas ingest lost (lowering/compile failure or injected
-                # fault): demote this facade to the XLA scatter path for
-                # good and replay the batch.  Failures surface at compile
-                # time -- before any donated buffer executes -- so the
-                # state is untouched and the replay is exact; the one
-                # pathological exception (an *execution* failure between
-                # chunks of a chunked dispatch) leaves donated buffers
-                # consumed, which the replay below then reports loudly
-                # instead of double-ingesting.
+                # Pallas ingest lost (lowering/compile failure or
+                # injected fault): demote this facade to the XLA
+                # scatter path for good and replay the batch.
+                # Failures surface at compile time -- before any
+                # donated buffer executes -- so the state is untouched
+                # and the replay is exact; the one pathological
+                # exception (an *execution* failure between chunks of
+                # a chunked dispatch) leaves donated buffers consumed,
+                # which the replay below then reports loudly instead
+                # of double-ingesting.
                 self._add_pallas = None
                 self._batch_ok = lambda s: False
                 resilience.record_downgrade(
@@ -1249,11 +1303,13 @@ class BatchedDDSketch:
                 )
                 try:
                     _eng = "xla"
-                    self._stream_op("add_xla", self._add_xla, values, weights)
+                    self._stream_op(
+                        "add_xla", self._add_xla, values, weights
+                    )
                 except Exception as e2:
                     raise resilience.EngineUnavailable(
-                        "ingest failed on both the Pallas and XLA engines;"
-                        " state may be partial"
+                        "ingest failed on both the Pallas and XLA"
+                        " engines; state may be partial"
                     ) from e2
         else:
             self._stream_op("add_xla", self._add_xla, values, weights)
@@ -1263,6 +1319,19 @@ class BatchedDDSketch:
                 "ingest_s", _t0, component="batched", engine=_eng
             )
             telemetry.counter_inc("batched.ingest_batches")
+            # Which construction rung actually served (README metric rows
+            # ``ingest.variant.*``): the forensic answer to "was this
+            # fleet on the packed construction".  Literal names per rung:
+            # the telemetry-names lint cross-checks each against the
+            # declared inventory.
+            if _eng == "pallas":
+                telemetry.counter_inc("ingest.variant.stock")
+            elif _eng == "pallas:packed":
+                telemetry.counter_inc("ingest.variant.packed")
+            elif _eng == "pallas:hifold":
+                telemetry.counter_inc("ingest.variant.hifold")
+            elif _eng == "pallas:cmpfree":
+                telemetry.counter_inc("ingest.variant.cmpfree")
         if tracing._ACTIVE:
             tracing.record_event(
                 "engine.ingest", engine=_eng, component="batched"

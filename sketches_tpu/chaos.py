@@ -17,6 +17,10 @@ site                      expected accounting
                           ``InjectedFault`` raised, previous file intact
 ``pallas.lowering``       query answers through the engine ladder with the
                           demotion recorded, or the floor re-raises
+``pallas.ingest_variant`` a non-stock ingest construction rung fails to
+                          lower -> the facade degrades to the stock rung
+                          (health-ledger recorded), the replayed batch's
+                          mass is exact, and no fault escapes
 ``mesh.shard``            the live-mask fold accounts the dead partial's
                           mass exactly (survivors stay an exact sketch)
 ``state.bitflip``         the integrity checker / fingerprint lane catches
@@ -277,6 +281,48 @@ def _fault_lowering(c: _Campaign, step: int) -> str:
     return "detected" if after > before else "undetected"
 
 
+def _fault_ingest_variant(c: _Campaign, step: int) -> str:
+    # The ingest construction-rung ladder (DESIGN.md 2-r17): a variant
+    # lowering failure must degrade to the stock rung -- recorded in the
+    # health ledger -- with the replayed batch's mass exact, never a
+    # fault escaping or a demotion all the way to XLA.  The campaign's
+    # own partials are 16-stream (XLA engine), so this driver runs a
+    # kernel-shaped facade of its own; after the first demotion the
+    # facade pins to stock and later draws report "skipped".
+    from sketches_tpu import kernels
+    from sketches_tpu.batched import BatchedDDSketch
+
+    if kernels.choose_ingest_engine(c.spec, weighted=False) == "stock":
+        return "skipped"  # kill switch pinned the ladder to stock
+    sk = getattr(c, "_variant_sk", None)
+    if sk is None:
+        sk = BatchedDDSketch(
+            128, relative_accuracy=_REL_ACC, n_bins=_N_BINS, engine="pallas"
+        )
+        c._variant_sk = sk
+    vals = np.exp(
+        c.rng.normal(0.0, 1.0, (128, _BATCH * 4))
+    ).astype(np.float32)
+    before_count = float(np.asarray(sk.state.count, np.float64).sum())
+    before = resilience.health()["counters"].get("downgrades", 0)
+    with faults.active(
+        {faults.PALLAS_INGEST_VARIANT: dict(times=1)}
+    ) as plans:
+        try:
+            sk.add(vals)
+        except (InjectedFault, resilience.EngineUnavailable):
+            return "undetected"  # the rung must degrade, not raise
+        fired = plans[faults.PALLAS_INGEST_VARIANT].fired
+    if fired == 0:
+        return "skipped"  # first add recenters (XLA) / already demoted
+    after = resilience.health()["counters"].get("downgrades", 0)
+    if after <= before or sk._add_pallas is None:
+        return "undetected"
+    total = float(np.asarray(sk.state.count, np.float64).sum())
+    exact = abs(total - before_count - float(vals.size)) <= 1e-6 * vals.size
+    return "detected" if exact else "undetected"
+
+
 def _fault_mesh_shard(c: _Campaign, step: int) -> str:
     dead = step % 2
     live = np.ones((2,), bool)
@@ -348,6 +394,7 @@ _FAULT_DRIVERS = {
     faults.WIRE_BLOB: _fault_wire_blob,
     faults.CHECKPOINT_WRITE: _fault_checkpoint,
     faults.PALLAS_LOWERING: _fault_lowering,
+    faults.PALLAS_INGEST_VARIANT: _fault_ingest_variant,
     faults.MESH_SHARD: _fault_mesh_shard,
     faults.STATE_BITFLIP: _fault_bitflip,
 }

@@ -13,6 +13,11 @@ site                    simulates
 ``pallas.lowering``     a Pallas query-kernel lowering/compile failure
                         (raises at the facade dispatch, per engine ``tier``)
 ``pallas.ingest``       a Pallas ingest-kernel failure
+``pallas.ingest_variant``  a lowering/compile failure of a non-stock
+                        ingest construction variant (the r17 packed /
+                        hifold / cmpfree rungs; the facade must degrade
+                        to the stock rung, recorded in the health
+                        ledger -- ``tier`` restricts to one variant)
 ``checkpoint.write``    a torn checkpoint write (``mode="truncate"``) or a
                         crash before the atomic rename (``mode="raise"``)
 ``mesh.shard``          dead value shard(s) -- consumed by
@@ -78,6 +83,7 @@ __all__ = [
     "NATIVE_LOAD",
     "PALLAS_LOWERING",
     "PALLAS_INGEST",
+    "PALLAS_INGEST_VARIANT",
     "WIRE_BLOB",
     "CHECKPOINT_WRITE",
     "MESH_SHARD",
@@ -110,6 +116,7 @@ FAULTS_ENV = registry.FAULTS.name
 NATIVE_LOAD = "native.load"
 PALLAS_LOWERING = "pallas.lowering"
 PALLAS_INGEST = "pallas.ingest"
+PALLAS_INGEST_VARIANT = "pallas.ingest_variant"
 WIRE_BLOB = "wire.blob"
 CHECKPOINT_WRITE = "checkpoint.write"
 MESH_SHARD = "mesh.shard"
@@ -125,6 +132,7 @@ SITES = (
     NATIVE_LOAD,
     PALLAS_LOWERING,
     PALLAS_INGEST,
+    PALLAS_INGEST_VARIANT,
     WIRE_BLOB,
     CHECKPOINT_WRITE,
     MESH_SHARD,

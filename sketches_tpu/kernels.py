@@ -54,6 +54,10 @@ from sketches_tpu.resilience import SketchValueError, SpecError
 __all__ = [
     "supports",
     "select_engine",
+    "INGEST_VARIANTS",
+    "packed_ingest_enabled",
+    "ingest_variant_supported",
+    "choose_ingest_engine",
     "ingest_histogram",
     "fused_quantile",
     "fused_quantile_windowed",
@@ -69,6 +73,90 @@ __all__ = [
 LO = 128  # lane width: low radix of the key split
 _BN = 128  # streams per block
 _BS = 128  # values per chunk
+
+#: The ingest construction-variant ladder (DESIGN.md 2-r17).  All four
+#: rungs emit BIT-IDENTICAL histograms and scalar columns; they differ
+#: only in how the one-hot matmul operands are built:
+#:
+#: * ``stock``   -- the r4 formulation: (LO + 2*HI) int8 compare+cast
+#:   rows per value (the §2-r5 construction-issue bound).
+#: * ``packed``  -- two LO bins per bf16 lane: lanes ``r`` and ``r + 64``
+#:   share one packed row (digit weights 1 / 256; per-subchunk counts
+#:   <= 128 < 256 keep the base-256 digits carry-free, so the split is
+#:   exact), halving the lo rows to 64; fixed [64 -> 128] placement
+#:   matrices contracted on the MXU re-expand the digit planes so the
+#:   VPU never touches a sub-128-lane reshape.
+#: * ``hifold``  -- pos/neg stores share the hi rows: one [HI] operand
+#:   with digit weights 1 (pos) / 256 (neg), unpacked the same way --
+#:   2*HI rows collapse to HI.
+#: * ``cmpfree`` -- stock layout, compare-free rows: saturating iota
+#:   arithmetic (``1 - min(key ^ iota, 1)``) emits the 0/1 bits without
+#:   a vector-mask select (the §2-r5 escape (b); see the 2-r17 dead
+#:   list for the measured verdict).
+#:
+#: Packed rungs apply to UNIT-WEIGHT calls only: the digit unpack needs
+#: integer per-cell masses, and arbitrary f32 weights destroy the digit
+#: separation (2-r17 dead-list entry).  Weighted calls always build the
+#: stock 3-term bf16 construction, whatever the selected variant.
+INGEST_VARIANTS = ("stock", "packed", "hifold", "cmpfree")
+
+#: Environment kill switch for the packed construction rungs: set to "0"
+#: to pin every facade to the stock construction without a code change.
+#: Declared in ``analysis/registry.py`` (the kill-switch inventory).
+INGEST_PACKED_ENV = registry.INGEST_PACKED.name
+
+
+def packed_ingest_enabled() -> bool:
+    """Whether the facades may select a non-stock ingest construction.
+
+    Reads the registered ``SKETCHES_TPU_INGEST_PACKED`` kill switch;
+    with it set to ``0`` every auto pick degrades to the stock rung
+    (never an error -- the rungs are bit-identical by construction)."""
+    return registry.enabled(registry.INGEST_PACKED)
+
+
+def ingest_variant_supported(
+    spec: SketchSpec, variant: str, weighted: bool
+) -> bool:
+    """Whether ``variant`` can serve this (spec, weightedness) at all.
+
+    ``stock`` serves everything the Pallas engine supports; the packed /
+    folded / compare-free rungs are unit-weight constructions (see
+    :data:`INGEST_VARIANTS`): f32-weighted masses break the base-256
+    digit algebra, so weighted calls are served by the stock rung.
+    """
+    if variant not in INGEST_VARIANTS:
+        raise SpecError(
+            f"Unknown ingest variant {variant!r}; expected one of"
+            f" {INGEST_VARIANTS}"
+        )
+    return variant == "stock" or not weighted
+
+
+def choose_ingest_engine(
+    spec: SketchSpec, weighted: bool, variant: Optional[str] = None
+) -> str:
+    """The facades' ingest construction-rung policy, in ONE place.
+
+    ``variant=None`` is the auto pick: the packed rung (the analytically
+    narrowest construction -- 64 + 2*HI rows vs the stock 128 + 2*HI)
+    for unit-weight calls when the ``SKETCHES_TPU_INGEST_PACKED`` kill
+    switch allows it, the stock rung otherwise.  An explicit ``variant``
+    is validated against :func:`ingest_variant_supported` and honored
+    (bench stage strips and the parity suite address rungs directly).
+    Both ``BatchedDDSketch`` and ``DistributedDDSketch`` route through
+    this so the two tiers can never diverge on the policy.
+    """
+    if variant is not None:
+        if not ingest_variant_supported(spec, variant, weighted):
+            raise SpecError(
+                f"ingest variant {variant!r} does not support"
+                f" weighted={weighted} (unit-weight construction only)"
+            )
+        return variant
+    if weighted or not packed_ingest_enabled():
+        return "stock"
+    return "packed"
 
 
 def _wide_block(dim: int, n_bins: int, base: int, gate: int = 1024) -> int:
@@ -152,12 +240,15 @@ def _ingest_kernel(
     *,
     spec: SketchSpec,
     weighted: bool,
+    variant: str = "stock",
 ):
     """One (stream-block, value-chunk) grid cell of the fused ingest.
 
     Emits the scalar bookkeeping (zero/count/sum/min/max/collapse/bounds)
     as one packed [block, 16] column output (layout ``_COL``) alongside the
     histograms, so the values make exactly one trip from HBM.
+    ``variant`` selects the one-hot construction rung (see
+    :data:`INGEST_VARIANTS`); every rung emits bit-identical outputs.
     """
     j = pl.program_id(1)
     n_bins = spec.n_bins
@@ -258,7 +349,34 @@ def _ingest_kernel(
     hi_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, 2 * hi_size, _BS), 1)
     lo_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, LO, _BS), 1)
     nt_dims = (((2,), (2,)), ((0,), (0,)))  # contract lanes; batch streams
-    acc_dt = jnp.float32 if weighted else jnp.int32
+    unit_variant = variant if not weighted else "stock"
+    acc_dt = (
+        jnp.float32
+        if weighted or unit_variant in ("packed", "hifold")
+        else jnp.int32
+    )
+    if unit_variant == "packed":
+        # Pair lanes r and r + 64 into one packed row (digit weights
+        # 1 / 256); the fixed placement matrices below re-expand the two
+        # digit planes on the MXU, so the kernel never reshapes a
+        # sub-128-lane block (the §2-r5 layout trap).
+        pk_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, LO // 2, _BS), 1)
+        u_r = jax.lax.broadcasted_iota(jnp.int32, (LO // 2, LO), 0)
+        u_l = jax.lax.broadcasted_iota(jnp.int32, (LO // 2, LO), 1)
+        unpack_low = (u_l == u_r).astype(jnp.bfloat16)  # [64, 128]
+        unpack_high = (u_l == u_r + LO // 2).astype(jnp.bfloat16)
+        u_dims = (((2,), (0,)), ((), ()))  # [bn, R, 64] @ [64, 128]
+    elif unit_variant == "hifold":
+        # Pos/neg share the hi rows: digit weights 1 (pos) / 256 (neg),
+        # zero for dead/zero/NaN lanes (signed == 0 there, same masking
+        # as the stock live fold).
+        hp = idx // LO  # [BN, BS] in [0, HI) -- no store offset
+        sscale = jnp.where(
+            signed > 0.0,
+            jnp.where(is_neg, jnp.float32(256.0), jnp.float32(1.0)),
+            0.0,
+        )
+        hp_iota = jax.lax.broadcasted_iota(jnp.int32, (bn, hi_size, _BS), 1)
     c = jnp.zeros((bn, 2 * hi_size, LO), acc_dt)
     for t in range(bs // _BS):
         # lax.slice_in_dim, not mixed None+slice getitem: the latter takes
@@ -275,6 +393,79 @@ def _ingest_kernel(
                 c = c + jax.lax.dot_general(
                     a, onehot_lo, nt_dims, preferred_element_type=jnp.float32
                 )  # [BN, 2HI, LO]
+        elif unit_variant == "packed":
+            # 64 packed lo rows instead of 128: row r carries 1 for
+            # lo == r and 256 for lo == r + 64 (both bf16-exact; the two
+            # cases are exclusive per value, so no lane ever holds 257).
+            # Per-subchunk counts are <= _BS = 128 < 256, so the f32
+            # accumulator's base-256 digits never carry and the integer
+            # split below is exact -- bit-identical to the stock rung.
+            pr_t = jnp.bitwise_and(lo_t, LO // 2 - 1)  # lo mod 64
+            hb_t = jnp.right_shift(lo_t, 6)  # lo >= 64 flag (0/1)
+            # Per-VALUE amplitude (live fold + digit weight in one [BN,
+            # _BS] vector, O(1) ops per value -- NOT per row): the rows
+            # below stay 2-op compare+cast / compare+select, which is
+            # where the width halving actually lands.
+            amp_t = jnp.where(
+                w_t > 0.0,
+                jnp.where(hb_t == 1, jnp.float32(256.0), jnp.float32(1.0)),
+                0.0,
+            )
+            a16 = jnp.where(
+                hi_t[:, None, :] == hi_iota, amp_t[:, None, :], 0.0
+            ).astype(jnp.bfloat16)  # [BN, 2HI, _BS]
+            p16 = (pr_t[:, None, :] == pk_iota).astype(
+                jnp.bfloat16
+            )  # [BN, 64, _BS]: 64 rows, 2 ops each -- half the stock lo
+            cp = jax.lax.dot_general(
+                a16, p16, nt_dims, preferred_element_type=jnp.float32
+            )  # [BN, 2HI, 64]: low digit + 256 * high digit, exact ints
+            oi = cp.astype(jnp.int32)
+            lowd = jnp.bitwise_and(oi, 255).astype(jnp.bfloat16)
+            highd = jnp.right_shift(oi, 8).astype(jnp.bfloat16)
+            # MXU-absorbed unpack: place digit plane r at lane r (low)
+            # and lane r + 64 (high) -- two [64 -> 128] matmuls instead
+            # of any sub-128-minor reshape/concat (no Mosaic lowering).
+            c = c + jax.lax.dot_general(
+                lowd, unpack_low, u_dims, preferred_element_type=jnp.float32
+            )
+            c = c + jax.lax.dot_general(
+                highd, unpack_high, u_dims, preferred_element_type=jnp.float32
+            )
+        elif unit_variant == "hifold":
+            # HI hi rows instead of 2*HI: pos counts ride the 1s digit,
+            # neg counts the 256s digit of one folded matmul; the split
+            # is exact by the same per-subchunk <= 128 < 256 bound.
+            hp_t = jax.lax.slice_in_dim(hp, t * _BS, (t + 1) * _BS, axis=1)
+            ssc_t = jax.lax.slice_in_dim(
+                sscale, t * _BS, (t + 1) * _BS, axis=1
+            )
+            a16 = jnp.where(
+                hp_t[:, None, :] == hp_iota, ssc_t[:, None, :], 0.0
+            ).astype(jnp.bfloat16)  # [BN, HI, _BS]
+            b16 = (lo_t[:, None, :] == lo_iota).astype(jnp.bfloat16)
+            cp = jax.lax.dot_general(
+                a16, b16, nt_dims, preferred_element_type=jnp.float32
+            )  # [BN, HI, LO]
+            oi = cp.astype(jnp.int32)
+            posd = jnp.bitwise_and(oi, 255).astype(jnp.float32)
+            negd = jnp.right_shift(oi, 8).astype(jnp.float32)
+            # Sublane concat (pos rows then neg rows) -- matches the
+            # stock 2*HI row layout exactly; lane offsets agree.
+            c = c + jnp.concatenate([posd, negd], axis=1)
+        elif unit_variant == "cmpfree":
+            # Stock layout, compare-free rows: 1 - min(key ^ iota, 1)
+            # emits the same 0/1 bits from saturating integer arithmetic
+            # (no vector-mask select).  Kept as a rung for the stage
+            # strips; the 2-r17 dead list records the measured verdict.
+            live8 = (w_t > 0.0)[:, None, :].astype(jnp.int8)
+            xh = jnp.bitwise_xor(hi_t[:, None, :], hi_iota)
+            a8 = (1 - jnp.minimum(xh, 1)).astype(jnp.int8) * live8
+            xl = jnp.bitwise_xor(lo_t[:, None, :], lo_iota)
+            b8 = (1 - jnp.minimum(xl, 1)).astype(jnp.int8)
+            c = c + jax.lax.dot_general(
+                a8, b8, nt_dims, preferred_element_type=jnp.int32
+            )
         else:
             live_t = (w_t > 0.0)[:, None, :]
             a8 = jnp.logical_and(
@@ -284,7 +475,7 @@ def _ingest_kernel(
             c = c + jax.lax.dot_general(
                 a8, b8, nt_dims, preferred_element_type=jnp.int32
             )
-    if not weighted:
+    if c.dtype != jnp.float32:
         # Exact: per-call counts are bounded by the batch width << 2**31.
         c = c.astype(jnp.float32)
     # Per-tile masses of this chunk's histogram: a lane reduction over the
@@ -371,6 +562,7 @@ def ingest_histogram(
     *,
     weighted: bool = True,
     interpret: bool = False,
+    variant: str = "stock",
 ) -> Tuple[jax.Array, ...]:
     """One fused pass over a value batch -> histograms + scalar bookkeeping.
 
@@ -380,8 +572,15 @@ def ingest_histogram(
     histograms of this batch plus the packed [n_streams, 16] per-stream
     counter deltas (column layout ``_COL``: zero/count/sum/min/max/
     collapse/per-store occupied bounds/negative total), all from a single
-    HBM read of the values.
+    HBM read of the values.  ``variant`` picks the construction rung
+    (:data:`INGEST_VARIANTS`; bit-identical outputs by construction).
     """
+    if not ingest_variant_supported(spec, variant, weighted):
+        raise SpecError(
+            f"ingest variant {variant!r} does not support weighted calls"
+            " (unit-weight construction only); the facades route these"
+            " to the stock rung automatically"
+        )
     n, s = values.shape
     bs = _wide_block(s, spec.n_bins, _BS, gate=2048)
     grid = (n // _BN, s // bs)
@@ -394,7 +593,9 @@ def ingest_histogram(
         (_BN, ncols), lambda i, j: (i, 0), memory_space=pltpu.VMEM
     )
     return pl.pallas_call(
-        functools.partial(_ingest_kernel, spec=spec, weighted=weighted),
+        functools.partial(
+            _ingest_kernel, spec=spec, weighted=weighted, variant=variant
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((_BN, bs), lambda i, j: (i, j), memory_space=pltpu.VMEM),
@@ -1910,12 +2111,16 @@ def add(
     weights: Optional[jax.Array] = None,
     *,
     interpret: bool = False,
+    variant: Optional[str] = None,
 ) -> SketchState:
     """Drop-in replacement for ``batched.add`` using the fused Pallas pass.
 
     Unit-weight calls (``weights=None``) take the single-term bf16 one-hot
     path; explicit weights use the exact three-term bf16 split (see module
     docstring), so arbitrary f32 weights accumulate without quantization.
+    ``variant=None`` resolves the construction rung through
+    :func:`choose_ingest_engine` (kill-switch-aware); an explicit rung is
+    honored after validation.
     """
     v = values.astype(spec.dtype)
     if spec.bins_integer:
@@ -1945,6 +2150,7 @@ def add(
     hist_pos, hist_neg, cols = ingest_histogram(
         spec, v, w, state.key_offset,
         weighted=weights is not None, interpret=interpret,
+        variant=choose_ingest_engine(spec, weights is not None, variant),
     )
     col = lambda name: cols[:, _COL[name]]
     zero, count, total = col("zero"), col("count"), col("sum")

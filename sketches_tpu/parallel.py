@@ -768,6 +768,9 @@ class DistributedDDSketch:
             # shard-local batch width qualifies, the portable XLA scatter
             # path otherwise.  Weighted integer-bin calls always take XLA
             # (kernel f32 deltas are only unit-weight-exact; kernels.add).
+            # The ingest construction rung resolves at trace time through
+            # the same choose_ingest_engine policy as the batched facade
+            # (kill-switch-aware; kernels.add's variant=None default).
             if (
                 use_pallas
                 and kernels.supports(spec, n_local_streams, values.shape[-1])
@@ -775,6 +778,14 @@ class DistributedDDSketch:
             ):
                 return kernels.add(spec, st, values, weights, interpret=interpret)
             return add(spec, st, values, weights)
+
+        # The construction rung the unit-weight shard-local ingest resolves
+        # to (telemetry/forensics label; the jits above bind it at trace).
+        self._ingest_variant = (
+            kernels.choose_ingest_engine(spec, weighted=False)
+            if use_pallas
+            else "xla"
+        )
 
         def local_ingest(partials, values, weights):
             st = jax.tree.map(lambda x: x[0], partials)
@@ -1045,6 +1056,18 @@ class DistributedDDSketch:
                 "ingest_s", _t0, component="distributed", engine="shard_map"
             )
             telemetry.counter_inc("distributed.ingest_batches")
+            if self.engine == "pallas" and weights is None:
+                # The construction rung the shard-local unit ingest bound
+                # at trace time (README metric rows ``ingest.variant.*``).
+                # Literal names per rung (telemetry-names lint).
+                if self._ingest_variant == "stock":
+                    telemetry.counter_inc("ingest.variant.stock")
+                elif self._ingest_variant == "packed":
+                    telemetry.counter_inc("ingest.variant.packed")
+                elif self._ingest_variant == "hifold":
+                    telemetry.counter_inc("ingest.variant.hifold")
+                elif self._ingest_variant == "cmpfree":
+                    telemetry.counter_inc("ingest.variant.cmpfree")
         if _p0 is not None:
             profiling.record("ingest", "shard_map", _p0, self.partials)
         if accuracy._ACTIVE:

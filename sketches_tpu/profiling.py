@@ -100,6 +100,12 @@ _TIER_ENTRY: Dict[Tuple[str, str], str] = {
     ("query", "wxla"): "kernels.quantile_windowed_xla",
     ("query", "xla"): "batched.quantile",
     ("ingest", "pallas"): "kernels.ingest_histogram",
+    # Construction-variant rungs (kernels.INGEST_VARIANTS): each maps to
+    # its own audited entry so the roofline join names the rung that
+    # actually served (same bytes, different construction width).
+    ("ingest", "pallas:packed"): "kernels.ingest_histogram:packed",
+    ("ingest", "pallas:hifold"): "kernels.ingest_histogram:hifold",
+    ("ingest", "pallas:cmpfree"): "kernels.ingest_histogram:cmpfree",
     ("ingest", "xla"): "batched.add",
     ("ingest", "recenter"): "batched.add",
     ("ingest", "shard_map"): "batched.add",

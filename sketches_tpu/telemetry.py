@@ -119,6 +119,9 @@ __all__ = [
     "EXEMPLARS_PER_BIN",
     "EXEMPLAR_BINS",
     "check_bench",
+    "capture_class",
+    "capture_mismatch",
+    "find_comparable_pair",
     "SLO",
     "SLOS",
     "check_slo",
@@ -186,6 +189,17 @@ _DECLARED = (
            "Batches ingested through BatchedDDSketch.add."),
     Metric("distributed.ingest_batches", "counter", "sketches_tpu.parallel",
            "Batches ingested through DistributedDDSketch.add."),
+    Metric("ingest.variant.stock", "counter", "sketches_tpu.kernels",
+           "Pallas ingest batches served by the stock int8 construction."),
+    Metric("ingest.variant.packed", "counter", "sketches_tpu.kernels",
+           "Pallas ingest batches served by the packed sub-byte lo"
+           " construction (DESIGN.md 2-r17)."),
+    Metric("ingest.variant.hifold", "counter", "sketches_tpu.kernels",
+           "Pallas ingest batches served by the folded pos/neg hi"
+           " construction (2-r17; dead-listed default-off rung)."),
+    Metric("ingest.variant.cmpfree", "counter", "sketches_tpu.kernels",
+           "Pallas ingest batches served by the compare-free construction"
+           " (2-r17; dead-listed default-off rung)."),
     Metric("scalar.values", "counter", "sketches_tpu.ddsketch",
            "Values flushed through the JaxDDSketch scalar/bulk paths."),
     Metric("wire.blobs_encoded", "counter", "sketches_tpu.pb.wire",
@@ -1728,6 +1742,16 @@ BENCH_GATE: Tuple[Tuple[str, str, float], ...] = (
     ("configs.c1_10k_streams.query_p50_s", "lower", 0.30),
     ("configs.c2_c4_1m_streams_cubic_collapsing.ingest_fused_per_s",
      "higher", 0.15),
+    ("configs.c2_c4_1m_streams_cubic_collapsing"
+     ".ingest_fused_per_s_floorsub_batch512", "higher", 0.15),
+    ("configs.c2_c4_1m_streams_cubic_collapsing"
+     ".ingest_fused_per_s_floorsub_batch256", "higher", 0.15),
+    # Per-construction-rung floor-subtracted ingest (r17 variants; only
+    # present in driver captures that ran bench_ingest_variants on TPU).
+    ("configs.ingest_variants.variants.stock.fused_floorsub_per_s",
+     "higher", 0.20),
+    ("configs.ingest_variants.variants.packed.fused_floorsub_per_s",
+     "higher", 0.20),
     ("configs.c2s_shard_query_131k.worst_mixed_sign.query_sustained_s",
      "lower", 0.30),
     ("configs.c2s_shard_query_131k.tight_telemetry.query_sustained_s",
@@ -1751,6 +1775,44 @@ def _lookup(doc: Any, path: str) -> Optional[float]:
     return cur if isinstance(cur, (int, float)) else None
 
 
+def capture_class(doc: dict) -> Dict[str, Optional[str]]:
+    """The comparability fingerprint of a bench document.
+
+    Two captures are comparable only when they ran on the same device
+    class AND (when both declare it) the same default ingest
+    construction rung -- an r06-style CPU-container capture compared
+    against a TPU driver capture regresses every device metric for a
+    reason that has nothing to do with the code (ISSUE 12 satellite 6:
+    the two were previously indistinguishable except by eyeballing the
+    ``device`` field).
+    """
+    device = doc.get("device")
+    dev_class: Optional[str] = None
+    if isinstance(device, str) and device:
+        dev_class = "tpu" if "tpu" in device.lower() else "cpu"
+    variant = doc.get("ingest_variant")
+    return {
+        "device_class": dev_class,
+        "ingest_variant": variant if isinstance(variant, str) else None,
+    }
+
+
+def capture_mismatch(old_doc: dict, new_doc: dict) -> Optional[str]:
+    """A named refusal reason when two bench documents are not
+    comparable, else None.  Fields absent from either side (older
+    captures predate the stamps) never refuse."""
+    old_c, new_c = capture_class(old_doc), capture_class(new_doc)
+    for key in ("device_class", "ingest_variant"):
+        a, b = old_c[key], new_c[key]
+        if a is not None and b is not None and a != b:
+            return (
+                f"cross-{key.replace('_', '-')} comparison:"
+                f" old={a!r} new={b!r} -- device-sustained metrics are"
+                " not comparable across capture classes"
+            )
+    return None
+
+
 def check_bench(
     old_doc: dict, new_doc: dict, tolerance: Optional[float] = None
 ) -> Tuple[List[str], int, int]:
@@ -1760,10 +1822,16 @@ def check_bench(
     Walks :data:`BENCH_GATE`; metrics absent from either document are
     skipped (configs legitimately come and go), so callers must treat
     ``n_compared == 0`` as a failure in its own right -- two
-    wrong-shaped files would otherwise "pass" vacuously.
+    wrong-shaped files would otherwise "pass" vacuously.  Documents of
+    different capture classes (:func:`capture_mismatch`) are REFUSED
+    with a named reason line and ``compared == 0`` -- never silently
+    compared, never silently passed.
     """
     lines: List[str] = []
     regressed = compared = 0
+    reason = capture_mismatch(old_doc, new_doc)
+    if reason is not None:
+        return [f"  REFUSED  {reason}"], 0, 0
     for path, direction, tol in BENCH_GATE:
         if tolerance is not None:
             tol = tolerance
@@ -1792,6 +1860,55 @@ def check_bench(
 def _load_json(path: str) -> dict:
     with open(path, "r", encoding="utf-8") as f:
         return json.load(f)
+
+
+def _round_of(path: str) -> int:
+    """The rNN round number encoded in a bench capture filename (-1 when
+    absent; lexicographic order then breaks ties)."""
+    import os
+    import re
+
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def find_comparable_pair(
+    paths: List[str],
+) -> Tuple[Optional[str], Optional[str], str]:
+    """The newest checked-in bench capture plus the newest OLDER capture
+    of the same class -> ``(old_path, new_path, reason)``.
+
+    This replaces the CI gate's pinned r04->r05 pair (ISSUE 12 satellite
+    1): the trajectory keeps growing, so the gate walks backward from
+    the newest capture to the first predecessor :func:`capture_mismatch`
+    accepts.  ``old_path`` is None when no predecessor is comparable
+    (first capture of a new device class / construction rung) -- the
+    caller reports ``reason`` and treats the gate as vacuous-by-name,
+    not silently green.
+    """
+    ranked = sorted(paths, key=lambda p: (_round_of(p), p))
+    if not ranked:
+        return None, None, "no bench captures found"
+    new_path = ranked[-1]
+    try:
+        new_doc = _load_json(new_path)
+    except (OSError, ValueError) as e:
+        return None, new_path, f"unreadable newest capture {new_path}: {e}"
+    reasons = []
+    for cand in reversed(ranked[:-1]):
+        try:
+            cand_doc = _load_json(cand)
+        except (OSError, ValueError) as e:
+            reasons.append(f"{cand}: unreadable ({e})")
+            continue
+        mismatch = capture_mismatch(cand_doc, new_doc)
+        if mismatch is None:
+            return cand, new_path, f"comparing {cand} -> {new_path}"
+        reasons.append(f"{cand}: {mismatch}")
+    detail = "; ".join(reasons) if reasons else "no older capture exists"
+    return None, new_path, (
+        f"no capture comparable with {new_path}: {detail}"
+    )
 
 
 def _slo_forensics(
@@ -1862,6 +1979,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar=("OLD", "NEW"),
         help="compare two bench.py summary JSONs (e.g. BENCH_local_r04.json"
         " BENCH_local_r05.json); non-zero exit on regression",
+    )
+    parser.add_argument(
+        "--check-bench-latest",
+        nargs="*",
+        metavar="PATH",
+        default=None,
+        help="gate the newest checked-in bench capture against its newest"
+        " COMPARABLE predecessor (same device class + ingest variant;"
+        " defaults to BENCH_local_r*.json in the working directory) --"
+        " replaces the pinned-pair invocation as the trajectory grows",
     )
     parser.add_argument(
         "--tolerance",
@@ -1956,6 +2083,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"check-slo: {evaluated} SLO(s) within budget")
         return 0
 
+    if args.check_bench_latest is not None:
+        import glob as _glob
+
+        paths = list(args.check_bench_latest) or sorted(
+            _glob.glob("BENCH_local_r*.json")
+        )
+        old_path, new_path, reason = find_comparable_pair(paths)
+        if old_path is None:
+            # Named vacuous pass: the first capture of a new device
+            # class / construction rung has nothing comparable behind
+            # it; say exactly why instead of exit-2 ambiguity.
+            print(f"check-bench-latest: gate vacuous -- {reason}")
+            return 0
+        print(f"check-bench-latest: {reason}")
+        args.check_bench = [old_path, new_path]
+
     if not args.check_bench:
         if acted:
             return 0
@@ -1971,6 +2114,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     for line in lines:
         print(line)
     if compared == 0:
+        if any("REFUSED" in line for line in lines):
+            # The named cross-capture refusal (satellite 6): the reason
+            # is already printed; the exit stays non-zero so a CI pair
+            # pinned across capture classes fails loudly, not vacuously.
+            print(
+                "check-bench: REFUSED cross-capture comparison (see the"
+                " named reason above); pick captures of one class or use"
+                " --check-bench-latest"
+            )
+            return 2
         print(
             "check-bench: no comparable metric between the two documents"
             " (wrong files?)"

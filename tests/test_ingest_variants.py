@@ -1,0 +1,399 @@
+"""Differential parity suite for the ingest construction-variant ladder
+(ISSUE 12 / DESIGN.md 2-r17).
+
+Every rung in ``kernels.INGEST_VARIANTS`` must emit BIT-IDENTICAL state to
+the stock int8 construction -- histograms, scalar counters, occupied
+bounds, and tile summaries -- across all four mappings, unit-weight and
+live-mask batches, NaN/zero/negative/padding values, and integer-bin
+specs.  The ladder itself is tested end to end: kill-switch routing, the
+``pallas.ingest_variant`` fault site degrading to the stock rung (health
+ledger recorded), and the static construction-width audit pinned so a
+width regression fails CI without waiting for a TPU bench run.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from sketches_tpu import faults, kernels, resilience, telemetry
+from sketches_tpu.analysis import jaxpr_audit, registry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec, init
+from sketches_tpu.resilience import SpecError
+
+N, S = 128, 256  # one stream block, two value subchunks
+MAPPINGS = (
+    "logarithmic",
+    "linear_interpolated",
+    "quadratic_interpolated",
+    "cubic_interpolated",
+)
+NON_STOCK = tuple(v for v in kernels.INGEST_VARIANTS if v != "stock")
+
+
+def _mixed_values(seed=0, n=N, s=S):
+    rng = np.random.RandomState(seed)
+    vals = rng.lognormal(0, 2, (n, s)).astype(np.float32)
+    vals[:, ::7] *= -1.0
+    vals[:, ::11] = 0.0
+    vals[0, :4] = [1e30, -1e30, 1e-30, np.nan]
+    vals[1, ::13] = np.nan
+    return vals
+
+
+def _state_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y), equal_nan=True)
+        for x, y in zip(jtu.tree_leaves(a), jtu.tree_leaves(b))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity of every rung
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mapping", MAPPINGS)
+@pytest.mark.parametrize("variant", NON_STOCK)
+def test_unit_weight_bit_identical(mapping, variant):
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256, mapping_name=mapping)
+    vals = jnp.asarray(_mixed_values())
+    ref = kernels.add(
+        spec, init(spec, N), vals, None, interpret=True, variant="stock"
+    )
+    out = kernels.add(
+        spec, init(spec, N), vals, None, interpret=True, variant=variant
+    )
+    assert _state_equal(ref, out)
+
+
+@pytest.mark.parametrize("variant", NON_STOCK)
+def test_live_mask_bit_identical(variant):
+    """0/1 weights through the unit kernel (the live-mask fold): every
+    rung must mask dead lanes identically to the stock construction."""
+    spec = SketchSpec(
+        relative_accuracy=0.01, n_bins=512, mapping_name="cubic_interpolated"
+    )
+    vals = jnp.asarray(_mixed_values(seed=3))
+    w = (np.random.RandomState(7).rand(N, S) > 0.25).astype(np.float32)
+    w = jnp.asarray(w)
+    ko = init(spec, N).key_offset
+    ref = kernels.ingest_histogram(
+        spec, vals, w, ko, weighted=False, interpret=True, variant="stock"
+    )
+    out = kernels.ingest_histogram(
+        spec, vals, w, ko, weighted=False, interpret=True, variant=variant
+    )
+    for a, b in zip(ref, out):
+        assert np.array_equal(np.asarray(a), np.asarray(b), equal_nan=True)
+
+
+@pytest.mark.parametrize("variant", NON_STOCK)
+def test_integer_bins_unit_weight_bit_identical(variant):
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256, bin_dtype=jnp.int32)
+    vals = jnp.asarray(np.abs(_mixed_values(seed=5)))
+    ref = kernels.add(
+        spec, init(spec, N), vals, None, interpret=True, variant="stock"
+    )
+    out = kernels.add(
+        spec, init(spec, N), vals, None, interpret=True, variant=variant
+    )
+    assert _state_equal(ref, out)
+
+
+def test_wide_value_blocks_bit_identical():
+    """512-wide batches take the widened value block (bs=256, two in-cell
+    subchunks per block): the per-subchunk digit bound (counts <= 128 <
+    256) is exactly what keeps the packed unpack carry-free there."""
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256)
+    # Adversarial: every value in one stream hits the SAME bucket, so
+    # per-subchunk per-cell counts reach the 128 maximum.
+    vals = np.full((N, 512), 2.5, np.float32)
+    vals[1] = _mixed_values(seed=11, s=512)[1]
+    vals = jnp.asarray(vals)
+    ref = kernels.add(
+        spec, init(spec, N), vals, None, interpret=True, variant="stock"
+    )
+    for variant in NON_STOCK:
+        out = kernels.add(
+            spec, init(spec, N), vals, None, interpret=True, variant=variant
+        )
+        assert _state_equal(ref, out), variant
+
+
+# ---------------------------------------------------------------------------
+# Ladder policy: chooser, kill switch, weighted routing
+# ---------------------------------------------------------------------------
+
+
+def test_choose_ingest_engine_policy(monkeypatch):
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256)
+    monkeypatch.delenv(registry.INGEST_PACKED.name, raising=False)
+    assert kernels.choose_ingest_engine(spec, weighted=False) == "packed"
+    assert kernels.choose_ingest_engine(spec, weighted=True) == "stock"
+    monkeypatch.setenv(registry.INGEST_PACKED.name, "0")
+    assert not kernels.packed_ingest_enabled()
+    assert kernels.choose_ingest_engine(spec, weighted=False) == "stock"
+    monkeypatch.setenv(registry.INGEST_PACKED.name, "1")
+    assert kernels.choose_ingest_engine(spec, weighted=False) == "packed"
+    # Explicit rungs are honored (kill switch gates only the auto pick).
+    monkeypatch.setenv(registry.INGEST_PACKED.name, "0")
+    assert (
+        kernels.choose_ingest_engine(spec, weighted=False, variant="hifold")
+        == "hifold"
+    )
+
+
+def test_weighted_rejects_non_stock_variants():
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=256)
+    for variant in NON_STOCK:
+        assert not kernels.ingest_variant_supported(spec, variant, True)
+        with pytest.raises(SpecError):
+            kernels.choose_ingest_engine(spec, weighted=True, variant=variant)
+        with pytest.raises(SpecError):
+            kernels.ingest_histogram(
+                spec,
+                jnp.zeros((N, 128), jnp.float32),
+                jnp.ones((N, 128), jnp.float32),
+                init(spec, N).key_offset,
+                weighted=True,
+                interpret=True,
+                variant=variant,
+            )
+    with pytest.raises(SpecError):
+        kernels.ingest_variant_supported(spec, "no_such_rung", False)
+
+
+def test_facade_parity_armed_vs_disarmed(monkeypatch):
+    """The facade answers identically with the packed rung armed and
+    disarmed -- the kill switch can never change an answer."""
+    vals = _mixed_values(seed=9)
+    results = []
+    for env in ("1", "0"):
+        monkeypatch.setenv(registry.INGEST_PACKED.name, env)
+        sk = BatchedDDSketch(n_streams=N, n_bins=256, engine="pallas")
+        sk.add(vals)  # first add recenters (XLA path)
+        sk.add(vals)  # second add takes the selected pallas rung
+        sk.add(vals, np.full((N, S), 0.5, np.float32))  # weighted -> stock
+        results.append(np.asarray(sk.get_quantile_values([0.01, 0.5, 0.99])))
+    assert np.array_equal(results[0], results[1], equal_nan=True)
+
+
+# ---------------------------------------------------------------------------
+# Ladder degrade: variant failure -> stock rung, health-ledger recorded
+# ---------------------------------------------------------------------------
+
+
+def _warm_facade(vals):
+    sk = BatchedDDSketch(n_streams=N, n_bins=256, engine="pallas")
+    sk.add(vals)  # recenter path; subsequent adds take the pallas rung
+    return sk
+
+
+def test_variant_fault_degrades_to_stock_rung(monkeypatch):
+    monkeypatch.delenv(registry.INGEST_PACKED.name, raising=False)
+    resilience.reset()
+    vals = _mixed_values(seed=1)
+    ref = _warm_facade(vals)
+    ref.add(vals)
+
+    sk = _warm_facade(vals)
+    faults.arm(faults.PALLAS_INGEST_VARIANT, times=1)
+    try:
+        sk.add(vals)  # injected variant failure -> stock replay
+    finally:
+        faults.disarm()
+    assert sk._ingest_variant_demoted
+    assert sk._add_pallas is not None  # NOT demoted all the way to XLA
+    h = resilience.health()
+    assert h["tiers"].get("batched.ingest_variant") == "stock"
+    assert any(
+        d["component"] == "batched.ingest_variant"
+        and d["from_tier"] == "packed"
+        and d["to_tier"] == "stock"
+        for d in h["downgrades"]
+    )
+    # The replayed batch is exact: answers bit-match the undisturbed twin.
+    q_ref = np.asarray(ref.get_quantile_values([0.1, 0.5, 0.9, 0.999]))
+    q_got = np.asarray(sk.get_quantile_values([0.1, 0.5, 0.9, 0.999]))
+    assert np.array_equal(q_ref, q_got, equal_nan=True)
+    # Subsequent adds stay on the stock rung without another fault.
+    sk.add(vals)
+    ref.add(vals)
+    assert _state_equal(ref.state, sk.state)
+
+
+def test_variant_fault_tier_scoped(monkeypatch):
+    """A plan scoped to another rung must not fire for the packed rung."""
+    monkeypatch.delenv(registry.INGEST_PACKED.name, raising=False)
+    vals = _mixed_values(seed=2)
+    sk = _warm_facade(vals)
+    faults.arm(faults.PALLAS_INGEST_VARIANT, times=1, tier="hifold")
+    try:
+        sk.add(vals)
+    finally:
+        faults.disarm()
+    assert not sk._ingest_variant_demoted
+
+
+def test_full_pallas_fault_still_demotes_to_xla():
+    """The pre-existing pallas.ingest site must keep its XLA demotion
+    through the restructured dispatch."""
+    resilience.reset()
+    vals = _mixed_values(seed=4)
+    sk = _warm_facade(vals)
+    faults.arm(faults.PALLAS_INGEST, times=1)
+    try:
+        sk.add(vals)
+    finally:
+        faults.disarm()
+    assert sk._add_pallas is None
+    h = resilience.health()
+    assert h["tiers"].get("batched.ingest") == "xla"
+
+
+def test_variant_counter_and_trace_label(monkeypatch):
+    monkeypatch.delenv(registry.INGEST_PACKED.name, raising=False)
+    vals = _mixed_values(seed=6)
+    sk = _warm_facade(vals)
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        sk.add(vals)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    counters = snap["counters"]
+    assert any(
+        k.startswith("ingest.variant.packed") for k in counters
+    ), sorted(counters)
+
+
+# ---------------------------------------------------------------------------
+# Static construction-width audit (satellite 2): the CI pin
+# ---------------------------------------------------------------------------
+
+# Measured ceilings at the audit's canonical single-cell shape (128
+# streams x 256 bins x 128 values; jax pinned by the container).  A
+# construction-width regression moves these UP and fails here -- no TPU
+# run needed.  Re-pin deliberately when the formulation changes.
+_AUDIT_CEILING = {
+    "stock": 350.0,
+    "packed": 240.0,
+    "hifold": 360.0,
+    "cmpfree": 615.0,
+}
+
+
+@pytest.mark.parametrize("variant", kernels.INGEST_VARIANTS)
+def test_elem_ops_per_value_pinned(variant):
+    ops = jaxpr_audit.elem_ops_per_value(variant=variant)
+    assert ops <= _AUDIT_CEILING[variant], (
+        f"{variant} construction width regressed: {ops:.1f} ops/value"
+        f" > pinned ceiling {_AUDIT_CEILING[variant]}"
+    )
+
+
+def test_packed_is_materially_narrower():
+    stock = jaxpr_audit.elem_ops_per_value(variant="stock")
+    packed = jaxpr_audit.elem_ops_per_value(variant="packed")
+    assert packed < 0.75 * stock, (stock, packed)
+
+
+def test_dead_rungs_are_wider_and_documented():
+    """hifold and cmpfree measure WIDER than stock -- the 2-r17 dead-list
+    verdicts; this pin keeps the dead list honest (if a jax change ever
+    makes them narrower, the entries must be re-litigated)."""
+    stock = jaxpr_audit.elem_ops_per_value(variant="stock")
+    assert jaxpr_audit.elem_ops_per_value(variant="hifold") > stock
+    assert jaxpr_audit.elem_ops_per_value(variant="cmpfree") > stock
+
+
+def test_audit_entry_points_include_variants():
+    names = [n for n, _, _ in jaxpr_audit.default_entry_points()]
+    for v in NON_STOCK:
+        assert f"kernels.ingest_histogram:{v}" in names
+
+
+# ---------------------------------------------------------------------------
+# Bench capture stamps + cross-variant gate refusal (satellites 1 + 6)
+# ---------------------------------------------------------------------------
+
+
+def test_check_bench_refuses_cross_variant():
+    old = {"device": "TFRT_CPU_0", "ingest_variant": "stock", "value": 1.0}
+    new = {"device": "TFRT_CPU_0", "ingest_variant": "packed", "value": 2.0}
+    lines, regressed, compared = telemetry.check_bench(old, new)
+    assert compared == 0 and regressed == 0
+    assert any("REFUSED" in line and "ingest-variant" in line for line in lines)
+
+
+def test_check_bench_refuses_cross_device():
+    old = {"device": "TPU_0(process=0,(0,0,0,0))", "value": 1.0}
+    new = {"device": "TFRT_CPU_0", "value": 1.0}
+    lines, _, compared = telemetry.check_bench(old, new)
+    assert compared == 0
+    assert any("device-class" in line for line in lines)
+
+
+def test_check_bench_tolerates_missing_stamps():
+    """Pre-r06 documents carry no ingest_variant: no refusal, normal walk."""
+    old = {"device": "TPU_0", "value": 10.0}
+    new = {"device": "TPU_1", "value": 10.5, "ingest_variant": "packed"}
+    lines, regressed, compared = telemetry.check_bench(old, new)
+    assert compared == 1 and regressed == 0
+
+
+def test_find_comparable_pair(tmp_path):
+    import json
+
+    def write(name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    p4 = write("BENCH_local_r04.json", {"device": "TPU_0", "value": 1.0})
+    p5 = write("BENCH_local_r05.json", {"device": "TPU_0", "value": 1.1})
+    p6 = write(
+        "BENCH_local_r06.json", {"device": "TFRT_CPU_0", "value": 0.1}
+    )
+    p7 = write(
+        "BENCH_local_r07.json",
+        {"device": "TFRT_CPU_0", "value": 0.1, "ingest_variant": "packed"},
+    )
+    # Newest = r07 (cpu): r06 is the newest comparable predecessor; the
+    # TPU captures are refused by class, NOT compared.
+    old, new, reason = telemetry.find_comparable_pair([p4, p5, p6, p7])
+    assert (old, new) == (p6, p7), reason
+    # Without r06/r07 the TPU pair is picked.
+    old, new, _ = telemetry.find_comparable_pair([p4, p5])
+    assert (old, new) == (p4, p5)
+    # A lone capture of a fresh class: vacuous by name, not silently.
+    old, new, reason = telemetry.find_comparable_pair([p5, p6])
+    assert old is None and new == p6 and "cross-device-class" in reason
+
+
+def test_compact_summary_stamps_variant():
+    import bench
+
+    doc = {
+        "metric": "m",
+        "value": 1,
+        "ingest_variant": "packed",
+        "configs": {
+            "ingest_variants": {
+                "default_variant": "packed",
+                "variants": {
+                    "stock": {"fused_floorsub_per_s": 5.3e9},
+                    "packed": {"fused_floorsub_per_s": 7.1e9},
+                    "hifold": {"elem_ops_per_value_512": 380.1},
+                },
+            }
+        },
+    }
+    summary = bench.compact_summary(doc, "BENCH_local_rX.json")
+    assert summary["ingest_variant"] == "packed"
+    assert summary["ingest_variant_rates"] == {
+        "stock": 5.3e9, "packed": 7.1e9,
+    }

@@ -463,6 +463,30 @@ def _detect_pallas_ingest():
     return resilience.health()["tiers"].get("batched.ingest") == "xla"
 
 
+def _detect_pallas_ingest_variant():
+    """A non-stock construction rung failing to lower degrades to the
+    STOCK rung (ledger-recorded) -- the Pallas engine itself survives
+    and the replayed batch's mass is exact."""
+    from sketches_tpu import kernels
+
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    n = kernels._BN
+    if kernels.choose_ingest_engine(spec, weighted=False) == "stock":
+        return True  # kill switch pinned the ladder: nothing to degrade
+    sk = BatchedDDSketch(n, spec=spec, engine="pallas")
+    faults.arm(faults.PALLAS_INGEST_VARIANT, times=1)
+    try:
+        sk.add(np.full((n, kernels._BS), 1.0, np.float32))
+    finally:
+        faults.disarm()
+    return (
+        resilience.health()["tiers"].get("batched.ingest_variant") == "stock"
+        and sk._add_pallas is not None
+        and float(np.asarray(sk.state.count, np.float64).sum())
+        == float(n * kernels._BS)
+    )
+
+
 def _detect_pallas_lowering():
     sk = _batched(seed=21)
     faults.arm(faults.PALLAS_LOWERING, times=1)
@@ -689,6 +713,7 @@ def _detect_serve_cache_poison():
 _SITE_DETECTORS = {
     faults.NATIVE_LOAD: _detect_native_load,
     faults.PALLAS_INGEST: _detect_pallas_ingest,
+    faults.PALLAS_INGEST_VARIANT: _detect_pallas_ingest_variant,
     faults.PALLAS_LOWERING: _detect_pallas_lowering,
     faults.WIRE_BLOB: _detect_wire_blob,
     faults.CHECKPOINT_WRITE: _detect_checkpoint_write,
