@@ -1485,6 +1485,99 @@ def bench_backend_frontier(skip_1m: bool = False):
     return out
 
 
+def bench_windowed(skip_1m: bool = False):
+    """Time-windowed quantiles: rotation overhead + window-query cost
+    vs the single-sketch baseline.
+
+    One windowed ring (5 s -> 20 s ladder) under a virtual clock
+    ingests until the ring holds a realistic covered set, then:
+
+    * ``rotation_overhead_s`` -- the extra cost of an ``add`` that
+      crosses a slice boundary (freeze + ladder cascade) over a plain
+      same-bucket ``add`` (medians of interleaved reps);
+    * ``window_query_p50_s`` -- the ONE fused stacked-merge dispatch
+      over the covered buckets (arity reported), vs
+      ``single_sketch_query_p50_s`` -- the same quantiles on one plain
+      ``BatchedDDSketch`` holding the same total mass (the price of
+      windowing is exactly the stacked merge).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+    from sketches_tpu.windows import VirtualClock, WindowConfig, WindowedSketch
+
+    n = 8_192 if skip_1m else 65_536
+    batch = 256
+    qs = [0.5, 0.9, 0.99]
+    spec = SketchSpec(relative_accuracy=0.01, n_bins=512)
+    cfg = WindowConfig(slices_s=(5.0, 20.0), lengths=(6, 3))
+    clock = VirtualClock(0.0)
+    wsk = WindowedSketch(n, spec=spec, config=cfg, clock=clock)
+    baseline = BatchedDDSketch(n, spec=spec)
+    rng = np.random.default_rng(7)
+    vals = jnp.asarray(
+        rng.lognormal(0.0, 0.8, (n, batch)).astype(np.float32)
+    )
+    jax.block_until_ready(vals)
+    # Fill the ring: one batch per slice until every rung holds mass.
+    for _ in range(10):
+        clock.advance(5.0)
+        wsk.add(vals)
+        baseline.add(vals)
+    # -- rotation overhead: boundary-crossing add vs same-bucket add --
+    plain, rotating = [], []
+    for rep in range(8):
+        clock.advance(0.5)  # stays inside the current slice
+        t0 = time.perf_counter()
+        wsk.add(vals)
+        jax.block_until_ready(jax.tree.leaves(wsk._live.state))
+        plain.append(time.perf_counter() - t0)
+        baseline.add(vals)
+        clock.advance(5.0)  # crosses a boundary: freeze + cascade
+        t0 = time.perf_counter()
+        wsk.add(vals)
+        jax.block_until_ready(jax.tree.leaves(wsk._live.state))
+        rotating.append(time.perf_counter() - t0)
+        baseline.add(vals)
+    plain_p50 = sorted(plain)[len(plain) // 2]
+    rotating_p50 = sorted(rotating)[len(rotating) // 2]
+    # -- window query vs the single-sketch baseline --
+    plan = wsk.window_plan(None)
+    jax.block_until_ready(wsk.query_plan(plan, qs))  # compile the fold
+    reps = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        jax.block_until_ready(wsk.query_plan(plan, qs))
+        reps.append(time.perf_counter() - t0)
+    window_p50 = sorted(reps)[len(reps) // 2]
+    jax.block_until_ready(baseline.get_quantile_values(qs))
+    reps = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        jax.block_until_ready(baseline.get_quantile_values(qs))
+        reps.append(time.perf_counter() - t0)
+    base_p50 = sorted(reps)[len(reps) // 2]
+    led = wsk.ledger()
+    return {
+        "n_streams": n,
+        "batch": batch,
+        "ladder": [
+            f"{s:g}s x {k}" for s, k in zip(cfg.slices_s, cfg.lengths)
+        ],
+        "covered_buckets": plan.n_covered,
+        "add_p50_s": round(plain_p50, 6),
+        "rotating_add_p50_s": round(rotating_p50, 6),
+        "rotation_overhead_s": round(rotating_p50 - plain_p50, 6),
+        "window_query_p50_s": round(window_p50, 6),
+        "single_sketch_query_p50_s": round(base_p50, 6),
+        "window_query_vs_single": round(
+            window_p50 / max(base_p50, 1e-9), 2
+        ),
+        "ledger_exact": led["total"] == led["live"] + led["retired"],
+    }
+
+
 def compact_summary(doc: dict, full_doc_name: str) -> dict:
     """Headline metrics only, guaranteed small: the driver's stdout tail
     capture truncates the full document mid-object (VERDICT r5 weak #4 --
@@ -1567,6 +1660,14 @@ def compact_summary(doc: dict, full_doc_name: str) -> dict:
         "serde_to_bytes_s": serde.get("to_bytes_s"),
         "fold_scaling_device_clocked": fold_curve,
         "backend_frontier": frontier_compact,
+        "windowed": {
+            k: (cfg.get("windowed") or {}).get(k)
+            for k in (
+                "covered_buckets", "rotation_overhead_s",
+                "window_query_p50_s", "single_sketch_query_p50_s",
+            )
+            if (cfg.get("windowed") or {}).get(k) is not None
+        } or None,
         "verify": doc.get("verify_pallas_vs_xla_on_device"),
         "device": doc.get("device"),
         "full_doc": full_doc_name,
@@ -1630,6 +1731,7 @@ def main():
     serde = bench_serde()
     frontier = bench_backend_frontier(args.skip_1m)
     ingest_variants = bench_ingest_variants(args.skip_1m)
+    windowed = bench_windowed(args.skip_1m)
     from sketches_tpu import telemetry
 
     doc = {
@@ -1648,6 +1750,7 @@ def main():
             "serde_bulk": serde,
             "backend_frontier": frontier,
             "ingest_variants": ingest_variants,
+            "windowed": windowed,
         },
         "membw_read": membw,
         "verify_pallas_vs_xla_on_device": verify,

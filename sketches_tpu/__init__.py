@@ -65,8 +65,10 @@ from sketches_tpu.store import (
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.parallel import DistributedDDSketch
 from sketches_tpu import backends
+from sketches_tpu import windows
+from sketches_tpu.windows import WindowConfig, WindowedSketch
 
-__version__ = "0.15.0"
+__version__ = "0.16.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -108,6 +110,11 @@ __all__ = [
     # Adaptive-accuracy backends (UDDSketch uniform collapse, compact
     # moment summaries) behind the Store/KeyMapping seam
     "backends",
+    # Time-windowed quantiles ("p99 over the last 5 minutes"): ring of
+    # time-slice bucket sketches + hierarchical coarsening ladder
+    "windows",
+    "WindowConfig",
+    "WindowedSketch",
     "ServeOverload",
     "DeadlineExceeded",
     "IntegrityError",

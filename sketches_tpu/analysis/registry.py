@@ -40,6 +40,7 @@ __all__ = [
     "FLIGHT_RECORDER",
     "INGEST_PACKED",
     "ADAPTIVE",
+    "WINDOWED",
     "REGISTRY",
     "declared",
     "get",
@@ -225,6 +226,19 @@ ADAPTIVE = EnvVar(
     ),
 )
 
+#: Time-windowed-quantile kill switch (``sketches_tpu.windows``).
+WINDOWED = EnvVar(
+    name="SKETCHES_TPU_WINDOWED",
+    default="1",
+    owner="sketches_tpu.windows",
+    doc=(
+        "Set to 0 to refuse time-windowed sketches: constructing a"
+        " WindowedSketch (or serving a window= query) raises SpecError"
+        " instead of silently answering unwindowed; plain facades are"
+        " unaffected."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
@@ -233,7 +247,7 @@ REGISTRY: Dict[str, EnvVar] = {
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
         ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
-        FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE,
+        FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE, WINDOWED,
     )
 }
 

@@ -39,6 +39,7 @@ __all__ = [
     "BACKEND_DENSE",
     "BACKEND_UNIFORM_COLLAPSE",
     "BACKEND_MOMENT",
+    "BACKEND_WINDOWED",
     "BACKEND_ENUM",
     "BACKEND_NAMES",
     "facade_for",
@@ -47,16 +48,21 @@ __all__ = [
 #: Wire-enum values (``SketchPayload.backend``; see
 #: ``sketches_tpu.backends.wirefmt``).  Append-only: decoders refuse
 #: unknown values loudly, so reusing a retired number would silently
-#: misdecode old blobs.
+#: misdecode old blobs.  ``BACKEND_WINDOWED`` is an *envelope-only*
+#: kind (a whole ring of bucket sketches, not a ``SketchSpec.backend``
+#: value): pre-r18 readers refuse it by enum value, and r18+ readers
+#: under a plain backend spec refuse it by name -- either way loudly.
 BACKEND_DENSE = 0
 BACKEND_UNIFORM_COLLAPSE = 1
 BACKEND_MOMENT = 2
+BACKEND_WINDOWED = 3
 
 #: backend name -> wire enum value (the ONE place the mapping lives).
 BACKEND_ENUM = {
     "dense": BACKEND_DENSE,
     "uniform_collapse": BACKEND_UNIFORM_COLLAPSE,
     "moment": BACKEND_MOMENT,
+    "windowed": BACKEND_WINDOWED,
 }
 
 #: wire enum value -> backend name.

@@ -56,6 +56,18 @@ the serving tier's robustness envelope: every injected fault must be
 the tenants' total mass must be conserved.  Anything else is
 ``undetected`` and fails the run.
 
+``--campaign windowed`` runs the TIME-WINDOW campaign: windowed rings
+(a serve-fronted dense ring, an adaptive ladder ring, and -- given >= 2
+devices -- a mesh-backed ring) rotate under a virtual clock while
+``window.rotate_torn`` tears rotations mid-ingest, checkpoint writes
+tear, wire envelopes corrupt, reshards tear mid-rotation, and the kill
+switch flips.  The accounting contract: every window query is
+bit-identical to the host-side oracle merge of its covered buckets,
+the per-bucket mass ledger is EXACT (``==``, never approximately) at
+every step, a torn rotation/reshard leaves the ring bit-identical, and
+a poisoned serve cache entry recomputes -- anything else is
+``undetected`` and fails the run.
+
 Failure modes: the harness itself raises ``SketchValueError`` on
 invalid arguments; a campaign that cannot complete (unexpected
 exception escaping an un-faulted op) records the error in the verdict
@@ -86,6 +98,7 @@ __all__ = [
     "run_serve_campaign",
     "run_elastic_campaign",
     "run_adaptive_campaign",
+    "run_windowed_campaign",
     "main",
 ]
 
@@ -1496,6 +1509,416 @@ def run_adaptive_campaign(
             own_tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# Windowed campaign (the time-window soak)
+# ---------------------------------------------------------------------------
+
+#: Windowed-campaign shape: tiny rings (bounded fused-fold arity keeps
+#: the per-arity compile count CI-sized), short virtual slices so a few
+#: hundred steps cross many rotation boundaries.
+_WD_STREAMS = 8
+_WD_BINS = 128
+_WD_BATCH = 16
+_WD_QS = (0.5, 0.99)
+_WD_WINDOWS = (7.0, 30.0, None)
+
+
+def _wd_audit_ring(name: str, wsk, expected_total: float) -> None:
+    """The exact mass-ledger audit (== everywhere, the acceptance
+    contract): total == live + retired, every bucket's ledger entry ==
+    its device mass, and the ring's total == the campaign's expectation.
+    Raises ``SketchError`` on any breach."""
+    led = wsk.ledger()
+    if led["total"] != led["live"] + led["retired"]:
+        raise SketchError(
+            f"{name}: ledger broke: total {led['total']:g} != live"
+            f" {led['live']:g} + retired {led['retired']:g}"
+        )
+    if led["total"] != expected_total:
+        raise SketchError(
+            f"{name}: ledger total {led['total']:g} != expected"
+            f" {expected_total:g}"
+        )
+    device = wsk.device_masses()
+    for rung, bid, mass in wsk.buckets():
+        got = device.get((rung, bid))
+        if got != mass:
+            raise SketchError(
+                f"{name}: bucket (rung {rung}, id {bid}) ledger"
+                f" {mass:g} != device {got}"
+            )
+
+
+def run_windowed_campaign(
+    steps: int, seed: int, tmpdir: Optional[str] = None
+) -> Dict[str, Any]:
+    """Run the seeded time-window chaos campaign -> the verdict.
+
+    Three rings rotate under one virtual clock: a dense ring served
+    THROUGH the serving tier (fingerprint-set cache keys, poison
+    detection), an adaptive ring with a collapse-on-retire ladder, and
+    -- when this process has >= 2 devices -- a mesh-backed ring that
+    reshards live.  Every step may ingest (clock advances), query a
+    window and compare bit-identically against the host-side oracle
+    merge, round-trip the windowed checkpoint or wire envelope, or
+    reshard; armed fault sites tear rotations mid-ingest
+    (``window.rotate_torn``), tear checkpoint writes, corrupt wire
+    envelopes, tear reshards mid-rotation, poison the serve cache, and
+    flip the ``SKETCHES_TPU_WINDOWED`` kill switch (which must refuse
+    loudly).  The per-bucket mass ledger is audited with ``==`` after
+    EVERY step.  ``ok`` iff every fault is detected or provably
+    harmless, every oracle comparison is bit-identical, and the ledger
+    never breaks.  Raises ``SketchValueError`` for non-positive
+    ``steps``; campaign-level failures are reported in the verdict,
+    not raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    import os as _os
+
+    import jax
+
+    from sketches_tpu import checkpoint, serve
+    from sketches_tpu.analysis import registry as _registry
+    from sketches_tpu.backends.wirefmt import (
+        windowed_from_bytes,
+        windowed_to_bytes,
+    )
+    from sketches_tpu.batched import SketchSpec
+    from sketches_tpu.resilience import SpecError, WireDecodeError
+    from sketches_tpu.windows import (
+        VirtualClock,
+        WindowConfig,
+        WindowedSketch,
+        oracle_quantile,
+    )
+
+    was_active, was_mode = integrity.enabled(), integrity.mode()
+    faults.disarm()
+    integrity.arm("quarantine")
+    own_tmp = None
+    if tmpdir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix="sketches_windowed_")
+        tmpdir = own_tmp.name
+    rng = np.random.default_rng(seed)
+    clock = VirtualClock(0.0)
+    dense_spec = SketchSpec(relative_accuracy=_REL_ACC, n_bins=_WD_BINS)
+    ad_spec = SketchSpec(
+        relative_accuracy=_REL_ACC, n_bins=_WD_BINS,
+        backend="uniform_collapse", collapse_threshold=0.05,
+    )
+    cfg = WindowConfig(slices_s=(5.0, 20.0), lengths=(3, 3))
+    ad_cfg = WindowConfig(
+        slices_s=(5.0, 20.0), lengths=(2, 2), collapse_levels=(0, 2)
+    )
+    srv = serve.SketchServer(clock=clock)
+    srv.add_tenant("w", _WD_STREAMS, window=cfg, spec=dense_spec)
+    rings: Dict[str, Any] = {
+        "dense": srv.tenant("w"),
+        "adaptive": WindowedSketch(
+            _WD_STREAMS, spec=ad_spec, config=ad_cfg, clock=clock
+        ),
+    }
+    n_devices = len(jax.devices())
+    if n_devices >= 2:
+        from sketches_tpu.parallel import SketchMesh
+
+        rings["mesh"] = WindowedSketch(
+            _WD_STREAMS, spec=dense_spec, config=cfg, clock=clock,
+            mesh=SketchMesh(2),
+        )
+    expected: Dict[str, float] = {k: 0.0 for k in rings}
+    events: List[Dict[str, Any]] = []
+    errors: List[str] = []
+
+    def _batch():
+        return rng.lognormal(
+            float(rng.normal(0.0, 0.5)), 0.7, (_WD_STREAMS, _WD_BATCH)
+        ).astype(np.float32)
+
+    def _ingest(step: int) -> None:
+        clock.advance(float(rng.uniform(0.5, 4.0)))
+        for name, wsk in rings.items():
+            wsk.add(_batch())
+            expected[name] += _WD_STREAMS * _WD_BATCH
+
+    def _query_oracle(step: int) -> None:
+        name = ("dense", "adaptive")[step % 2]
+        win = _WD_WINDOWS[int(rng.integers(len(_WD_WINDOWS)))]
+        wsk = rings[name]
+        got = np.asarray(wsk.quantile(_WD_QS, window=win))
+        want = np.asarray(oracle_quantile(wsk, _WD_QS, window=win))
+        if not np.array_equal(got, want, equal_nan=True):
+            raise SketchError(
+                f"{name}: window query diverged from the oracle merge"
+                f" (window={win}, max |diff|"
+                f" {np.nanmax(np.abs(got - want)):g})"
+            )
+
+    def _serve_query(step: int) -> None:
+        win = _WD_WINDOWS[int(rng.integers(len(_WD_WINDOWS)))]
+        res = srv.quantile("w", list(_WD_QS), window=win)
+        direct = np.asarray(rings["dense"].quantile(_WD_QS, window=win))
+        if not np.array_equal(res.values, direct, equal_nan=True):
+            raise SketchError(
+                f"serve window answer diverged from the ring"
+                f" (tier={res.tier}, window={win})"
+            )
+
+    def _checkpoint_roundtrip(step: int) -> None:
+        path = _os.path.join(tmpdir, "windowed.ckpt")
+        wsk = rings["dense"]
+        checkpoint.save_windowed(path, wsk)
+        restored = checkpoint.restore_windowed(
+            path, clock=VirtualClock(clock.t)
+        )
+        if restored.ledger() != wsk.ledger() \
+                or restored.buckets() != wsk.buckets():
+            raise SketchError("windowed checkpoint round trip drifted")
+
+    def _wire_roundtrip(step: int) -> None:
+        wsk = rings["adaptive"]
+        blob = windowed_to_bytes(wsk)
+        restored = windowed_from_bytes(
+            ad_spec, blob, clock=VirtualClock(clock.t)
+        )
+        if restored.ledger() != wsk.ledger() \
+                or restored.buckets() != wsk.buckets():
+            raise SketchError("windowed wire round trip drifted")
+
+    def _reshard(step: int) -> None:
+        wsk = rings.get("mesh")
+        if wsk is None:
+            return
+        target = (1, 2)[step % 2]
+        report = wsk.reshard(n_devices=target)
+        if report.n_dead:
+            raise SketchError("clean windowed reshard reported dead shards")
+
+    def _fault_rotate_torn(step: int) -> str:
+        name = ("dense", "adaptive")[step % 2]
+        wsk = rings[name]
+        clock.advance(float(rng.uniform(5.0, 12.0)))  # rotation now due
+        before_led = wsk.ledger()
+        before_buckets = wsk.buckets()
+        faults.arm(faults.WINDOW_ROTATE_TORN, times=1)
+        try:
+            wsk.add(_batch())
+            return "undetected"  # the tear did not surface
+        except InjectedFault:
+            pass
+        finally:
+            faults.disarm()
+        if wsk.ledger() != before_led or wsk.buckets() != before_buckets:
+            return "undetected"  # the tear mutated the ring
+        # The interrupted rotation must complete cleanly afterwards.
+        wsk.add(_batch())
+        expected[name] += _WD_STREAMS * _WD_BATCH
+        return "detected"
+
+    def _fault_ckpt(step: int) -> str:
+        path = _os.path.join(tmpdir, "torn_windowed.ckpt")
+        wsk = rings["dense"]
+        checkpoint.save_windowed(path, wsk)  # good previous file
+        mode = "truncate" if step % 2 else "raise"
+        with faults.active(
+            {faults.CHECKPOINT_WRITE: dict(mode=mode, times=1)}
+        ):
+            try:
+                checkpoint.save_windowed(path, wsk)
+                crashed = False
+            except InjectedFault:
+                crashed = True
+        if crashed:
+            checkpoint.restore_windowed(
+                path, clock=VirtualClock(clock.t)
+            )  # previous file must survive
+            return "detected"
+        try:
+            checkpoint.restore_windowed(path, clock=VirtualClock(clock.t))
+        except CheckpointCorrupt:
+            return "detected"
+        return "undetected"
+
+    def _fault_wire(step: int) -> str:
+        wsk = rings["dense"]
+        blob = bytearray(windowed_to_bytes(wsk))
+        if not blob:
+            return "skipped"
+        pos = int(rng.integers(len(blob)))
+        blob[pos] ^= 1 << int(rng.integers(8))
+        try:
+            restored = windowed_from_bytes(
+                dense_spec, bytes(blob), clock=VirtualClock(clock.t)
+            )
+        except (WireDecodeError, SpecError):
+            return "detected"  # structural damage refused loudly
+        except Exception:  # noqa: BLE001 - any loud failure is detection
+            return "detected"
+        if restored.ledger() == wsk.ledger() \
+                and restored.buckets() == wsk.buckets():
+            same_fp = restored.window_plan(None).digest \
+                == wsk.window_plan(None).digest
+            if same_fp:
+                return "harmless"  # flipped a byte the format ignores
+            return "detected"  # content moved: the fingerprint lane sees it
+        return "detected"  # ledger drifted visibly
+
+    def _fault_reshard_torn(step: int) -> str:
+        wsk = rings.get("mesh")
+        if wsk is None:
+            return "skipped"
+        clock.advance(float(rng.uniform(5.0, 9.0)))  # rotation pending
+        before_led = wsk.ledger()
+        faults.arm(faults.RESHARD_TORN, times=1)
+        try:
+            wsk.reshard(n_devices=2 if step % 2 else 1)
+            return "undetected"
+        except InjectedFault:
+            pass
+        finally:
+            faults.disarm()
+        if wsk.ledger() != before_led:
+            return "undetected"
+        got = np.asarray(wsk.quantile(_WD_QS, window=30.0))
+        want = np.asarray(oracle_quantile(wsk, _WD_QS, window=30.0))
+        return (
+            "detected"
+            if np.array_equal(got, want, equal_nan=True)
+            else "undetected"
+        )
+
+    def _fault_cache_poison(step: int) -> str:
+        win = 30.0
+        srv.quantile("w", list(_WD_QS), window=win)  # fill the entry
+        direct = np.asarray(rings["dense"].quantile(_WD_QS, window=win))
+        before = srv.stats()["cache_poisoned"]
+        faults.arm(faults.SERVE_CACHE_POISON, times=1)
+        try:
+            res = srv.quantile("w", list(_WD_QS), window=win)
+        finally:
+            faults.disarm()
+        if res.cached and srv.stats()["cache_poisoned"] == before:
+            # The poison flip may land on a bit the checksum round-trips
+            # identically only if it never fired; a served hit must have
+            # re-verified clean against the live fingerprint.
+            return (
+                "harmless"
+                if np.array_equal(res.values, direct, equal_nan=True)
+                else "undetected"
+            )
+        return (
+            "detected"
+            if np.array_equal(res.values, direct, equal_nan=True)
+            and srv.stats()["cache_poisoned"] == before + 1
+            else "undetected"
+        )
+
+    def _fault_kill_switch(step: int) -> str:
+        _switch = _registry.WINDOWED.name
+        prior = _os.environ.get(_switch)
+        _os.environ[_switch] = "0"
+        try:
+            try:
+                WindowedSketch(2, spec=dense_spec, clock=clock)
+                return "undetected"
+            except SpecError:
+                pass
+            try:
+                srv.add_tenant(f"k{step}", 2, window=True, spec=dense_spec)
+                return "undetected"
+            except SpecError:
+                return "detected"
+        finally:
+            if prior is None:
+                _os.environ.pop(_switch, None)
+            else:
+                _os.environ[_switch] = prior
+
+    ops = (
+        (_ingest, 0.4),
+        (_query_oracle, 0.2),
+        (_serve_query, 0.15),
+        (_checkpoint_roundtrip, 0.08),
+        (_wire_roundtrip, 0.07),
+        (_reshard, 0.1),
+    )
+    op_fns = [o[0] for o in ops]
+    op_ps = np.asarray([o[1] for o in ops])
+    op_ps = op_ps / op_ps.sum()
+    fault_sites = {
+        "window.rotate_torn": _fault_rotate_torn,
+        "checkpoint.write": _fault_ckpt,
+        "wire.blob": _fault_wire,
+        "reshard.torn": _fault_reshard_torn,
+        "serve.cache_poison": _fault_cache_poison,
+        "windowed.kill_switch": _fault_kill_switch,
+    }
+    site_names = tuple(fault_sites)
+    try:
+        for step in range(steps):
+            op = int(rng.choice(len(op_fns), p=op_ps))
+            try:
+                op_fns[op](step)
+            except Exception as e:  # un-faulted op must not fail
+                errors.append(f"step {step} op {op}: {e!r}")
+                break
+            if rng.random() < _FAULT_P:
+                site = site_names[int(rng.integers(len(site_names)))]
+                try:
+                    outcome = fault_sites[site](step)
+                except Exception as e:
+                    outcome = "undetected"
+                    errors.append(f"step {step} site {site}: {e!r}")
+                if outcome != "skipped":
+                    events.append(
+                        {"step": step, "site": site, "outcome": outcome}
+                    )
+                    _classify_forensics(site, outcome, step)
+            # The acceptance contract: the ledger is exact at EVERY
+            # step, not just at the end.
+            try:
+                for name, wsk in rings.items():
+                    _wd_audit_ring(name, wsk, expected[name])
+            except SketchError as e:
+                errors.append(f"step {step} audit: {e!r}")
+                break
+        outcomes: Dict[str, int] = {}
+        for ev in events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        ok = not errors and outcomes.get("undetected", 0) == 0
+        ledgers = {name: wsk.ledger() for name, wsk in rings.items()}
+        return {
+            "campaign": "windowed",
+            "steps": steps,
+            "seed": seed,
+            "ok": ok,
+            "n_faults": len(events),
+            "outcomes": outcomes,
+            "events": events,
+            "errors": errors,
+            "virtual_clock_s": clock.t,
+            "ledgers": ledgers,
+            "expected": expected,
+            "rung_effective_alpha": rings[
+                "adaptive"
+            ].rung_effective_alpha(),
+            "serve_stats": srv.stats(),
+            "integrity_reports": len(integrity.reports()),
+            "health": resilience.health(),
+            "telemetry": telemetry.snapshot() if telemetry.enabled()
+            else None,
+        }
+    finally:
+        faults.disarm()
+        if was_active:
+            integrity.arm(was_mode)
+        else:
+            integrity.disarm()
+        if own_tmp is not None:
+            own_tmp.cleanup()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry point: run the campaign, write the verdict, exit 0 iff
     every injected fault was accounted for (1 otherwise).
@@ -1515,7 +1938,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--steps", type=int, default=500)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
-        "--campaign", choices=("core", "serve", "elastic", "adaptive"),
+        "--campaign",
+        choices=("core", "serve", "elastic", "adaptive", "windowed"),
         default="core",
         help="core: the integrity soak over the storage/engine sites;"
         " serve: the serving-tier soak over the serve.* sites (every"
@@ -1526,7 +1950,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         " the accuracy-backend soak (collapse mid-ingest, mixed-gamma"
         " merges, backend wire round-trips under injected corruption,"
         " kill-switch refusal -- alpha contract audited at the"
-        " effective alpha, mass ledger exact)",
+        " effective alpha, mass ledger exact); windowed: the"
+        " time-window soak (rotation-mid-ingest tears, torn windowed"
+        " checkpoints, wire corruption, reshard-during-rotation, serve"
+        " cache poison, kill-switch refusal -- window queries"
+        " bit-identical to the oracle merge, per-bucket mass ledger"
+        " exact at every step)",
     )
     parser.add_argument(
         "--mode", choices=("raise", "quarantine"), default="raise",
@@ -1557,6 +1986,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdict = run_elastic_campaign(args.steps, args.seed, mode=args.mode)
     elif args.campaign == "adaptive":
         verdict = run_adaptive_campaign(args.steps, args.seed)
+    elif args.campaign == "windowed":
+        verdict = run_windowed_campaign(args.steps, args.seed)
     else:
         verdict = run_campaign(args.steps, args.seed, mode=args.mode)
     if args.out:

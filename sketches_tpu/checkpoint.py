@@ -41,7 +41,10 @@ from sketches_tpu import faults, integrity, telemetry
 from sketches_tpu.batched import BatchedDDSketch, SketchSpec, SketchState
 from sketches_tpu.resilience import CheckpointCorrupt
 
-__all__ = ["save", "restore", "restore_distributed", "save_state", "restore_state"]
+__all__ = [
+    "save", "restore", "restore_distributed", "save_state",
+    "restore_state", "save_windowed", "restore_windowed",
+]
 
 _FIELDS = [f.name for f in dataclasses.fields(SketchState)]
 
@@ -106,6 +109,47 @@ def _arrays_to_backend_state(spec: SketchSpec, arrays: dict):
     return SketchState(**arrays)
 
 
+def _spec_json(spec: SketchSpec) -> str:
+    """The spec's canonical checkpoint-metadata JSON (shared by the
+    batched and windowed checkpoint formats); never raises on a valid
+    spec."""
+    return json.dumps(
+        {
+            "relative_accuracy": spec.relative_accuracy,
+            "mapping_name": spec.mapping_name,
+            "n_bins": spec.n_bins,
+            "key_offset": spec.key_offset,
+            "dtype": jnp.dtype(spec.dtype).name,
+            "bin_dtype": jnp.dtype(spec.bin_dtype).name,
+            "backend": spec.backend,
+            "collapse_threshold": spec.collapse_threshold,
+            "max_collapses": spec.max_collapses,
+            "n_moments": spec.n_moments,
+        }
+    )
+
+
+def _spec_from_meta(meta: dict) -> SketchSpec:
+    """Rebuild a spec from checkpoint metadata (the restore-side twin
+    of :func:`_spec_json`; missing pre-round fields take their
+    historical defaults).  Invalid field values raise ``SpecError``
+    through the ``SketchSpec`` constructor."""
+    return SketchSpec(
+        relative_accuracy=meta["relative_accuracy"],
+        mapping_name=meta["mapping_name"],
+        n_bins=meta["n_bins"],
+        key_offset=meta["key_offset"],
+        dtype=jnp.dtype(meta["dtype"]),
+        # Pre-r3 checkpoints carry no bin_dtype: bins followed dtype.
+        bin_dtype=jnp.dtype(meta.get("bin_dtype", meta["dtype"])),
+        # Pre-r15 checkpoints carry no backend: every state was dense.
+        backend=meta.get("backend", "dense"),
+        collapse_threshold=meta.get("collapse_threshold", 0.01),
+        max_collapses=meta.get("max_collapses", 10),
+        n_moments=meta.get("n_moments", 12),
+    )
+
+
 def _digest(spec_json: str, arrays: dict) -> str:
     """Content checksum over the spec + every array's identity and bytes."""
     h = hashlib.sha256()
@@ -128,20 +172,7 @@ def save_state(path: str, spec: SketchSpec, state: SketchState) -> None:
         # (raise/quarantine per the armed mode).
         integrity.verify_state(spec, state, seam="checkpoint.save")
     arrays = _state_arrays(spec, state)
-    spec_json = json.dumps(
-        {
-            "relative_accuracy": spec.relative_accuracy,
-            "mapping_name": spec.mapping_name,
-            "n_bins": spec.n_bins,
-            "key_offset": spec.key_offset,
-            "dtype": jnp.dtype(spec.dtype).name,
-            "bin_dtype": jnp.dtype(spec.bin_dtype).name,
-            "backend": spec.backend,
-            "collapse_threshold": spec.collapse_threshold,
-            "max_collapses": spec.max_collapses,
-            "n_moments": spec.n_moments,
-        }
-    )
+    spec_json = _spec_json(spec)
     # Serialize to memory first: the bytes hit disk in one write, so the
     # only torn-write window left is the filesystem's own, which the
     # tmp+rename below closes.  (Write through a file object: np.savez on
@@ -224,20 +255,7 @@ def _restore_state_inner(path: str):
         )
         meta_json = bytes(data["__spec__"]).decode()
         meta = json.loads(meta_json)
-        spec = SketchSpec(
-            relative_accuracy=meta["relative_accuracy"],
-            mapping_name=meta["mapping_name"],
-            n_bins=meta["n_bins"],
-            key_offset=meta["key_offset"],
-            dtype=jnp.dtype(meta["dtype"]),
-            # Pre-r3 checkpoints carry no bin_dtype: bins followed dtype.
-            bin_dtype=jnp.dtype(meta.get("bin_dtype", meta["dtype"])),
-            # Pre-r15 checkpoints carry no backend: every state was dense.
-            backend=meta.get("backend", "dense"),
-            collapse_threshold=meta.get("collapse_threshold", 0.01),
-            max_collapses=meta.get("max_collapses", 10),
-            n_moments=meta.get("n_moments", 12),
-        )
+        spec = _spec_from_meta(meta)
         if spec.backend == "moment":
             fields = list(_MOMENT_FIELDS)
         elif spec.backend == "uniform_collapse":
@@ -400,3 +418,255 @@ def restore_distributed(
         live_mask=live_mask,
         n_hosts=n_hosts,
     )
+
+
+# ---------------------------------------------------------------------------
+# Windowed ring checkpoints (ring + ladder + ledger, atomically)
+# ---------------------------------------------------------------------------
+
+
+def _windowed_doc(wsk) -> Tuple[str, dict]:
+    """Flatten a WindowedSketch to (meta json, array dict) -- the
+    save-side half of the windowed checkpoint format.  Bucket ``k``'s
+    state arrays live under ``b{k}.<field>``; the meta carries the
+    spec, the ladder config, the per-bucket ledger entries (the live
+    bucket flagged), and the retired/total mass."""
+    spec = wsk.spec
+    buckets_meta = []
+    arrays: dict = {}
+    k = 0
+    for rung in range(wsk.config.n_rungs):
+        for bid in sorted(wsk._rungs[rung]):
+            b = wsk._rungs[rung][bid]
+            for name, arr in _state_arrays(spec, b.state).items():
+                arrays[f"b{k}.{name}"] = arr
+            buckets_meta.append(
+                {"rung": rung, "id": bid, "mass": b.mass, "live": False}
+            )
+            k += 1
+    if wsk._live_id is not None:
+        live_state = wsk._snapshot_state(wsk._live.state)
+        for name, arr in _state_arrays(spec, live_state).items():
+            arrays[f"b{k}.{name}"] = arr
+        buckets_meta.append(
+            {
+                "rung": 0, "id": wsk._live_id, "mass": wsk._live_mass,
+                "live": True,
+            }
+        )
+        k += 1
+    meta = {
+        "format": "windowed-v1",
+        "spec": json.loads(_spec_json(spec)),
+        "config": {
+            "slices_s": list(wsk.config.slices_s),
+            "lengths": list(wsk.config.lengths),
+            "collapse_levels": (
+                None if wsk.config.collapse_levels is None
+                else list(wsk.config.collapse_levels)
+            ),
+        },
+        "n_streams": wsk.n_streams,
+        "buckets": buckets_meta,
+        "total": wsk._total,
+        "retired": wsk._retired,
+        "rotations": wsk._rotations,
+        "ladder_collapses": wsk._ladder_collapses,
+        "cur": wsk._cur,
+    }
+    return json.dumps(meta, sort_keys=True), arrays
+
+
+def save_windowed(path: str, wsk) -> None:
+    """Checkpoint a ``WindowedSketch``: ring + ladder + exact mass
+    ledger in ONE atomically-renamed npz, so a crash mid-write can
+    never tear the ring apart from its ledger.
+
+    Same durability contract as :func:`save_state`: serialize to
+    memory, tmp + fsync + ``os.replace``, sha256 content checksum over
+    the meta and every bucket array; the armed integrity layer
+    verifies every bucket state before anything hits disk and embeds
+    per-bucket fingerprints for the restore-side re-verification.  The
+    armed ``checkpoint.write`` fault site tears/aborts exactly like the
+    batched path (the previous checkpoint survives).  Raises
+    ``SpecError`` for a non-windowed argument.
+    """
+    from sketches_tpu.resilience import SpecError
+    from sketches_tpu.windows import WindowedSketch
+
+    if not isinstance(wsk, WindowedSketch):
+        raise SpecError(
+            f"save_windowed needs a WindowedSketch; got"
+            f" {type(wsk).__name__} (use save() for plain facades)"
+        )
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    meta_json, arrays = _windowed_doc(wsk)
+    extra = {}
+    if integrity._ACTIVE:
+        k = 0
+        for rung in range(wsk.config.n_rungs):
+            for bid in sorted(wsk._rungs[rung]):
+                b = wsk._rungs[rung][bid]
+                integrity.verify_state(
+                    wsk.spec, b.state, seam="checkpoint.save_windowed"
+                )
+                extra[f"__fp_b{k}__"] = integrity.fingerprint(
+                    wsk.spec, b.state
+                )
+                k += 1
+        if wsk._live_id is not None:
+            live_state = wsk._snapshot_state(wsk._live.state)
+            integrity.verify_state(
+                wsk.spec, live_state, seam="checkpoint.save_windowed"
+            )
+            extra[f"__fp_b{k}__"] = integrity.fingerprint(
+                wsk.spec, live_state
+            )
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf,
+        __window__=np.frombuffer(meta_json.encode(), np.uint8),
+        __checksum__=np.frombuffer(
+            _digest(meta_json, arrays).encode(), np.uint8
+        ),
+        **extra,
+        **arrays,
+    )
+    data = buf.getvalue()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        if faults._ACTIVE:
+            data = faults.inject(faults.CHECKPOINT_WRITE, payload=data)
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        if _t0 is not None:
+            telemetry.finish_span("checkpoint.save_s", _t0)
+            telemetry.gauge_set("checkpoint.bytes", float(len(data)))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def restore_windowed(
+    path: str,
+    *,
+    clock=None,
+    mesh=None,
+    value_axis=None,
+    stream_axis=None,
+    engine: str = "auto",
+):
+    """Resume a :func:`save_windowed` checkpoint -> a ``WindowedSketch``
+    with its ring, ladder positions, and exact mass ledger intact.
+
+    ``clock`` must be consistent with the timeline the ring was saved
+    under (a virtual clock restores deterministically; rotation resumes
+    from the saved slice positions).  Passing ``mesh``/``value_axis``
+    re-homes the live bucket on a mesh-sharded fleet (frozen buckets
+    are topology-free and load anywhere -- the elastic resume
+    property).  Any torn/corrupted archive raises
+    :class:`CheckpointCorrupt`; an armed integrity layer re-verifies
+    every bucket state against its embedded fingerprint; a missing file
+    stays ``FileNotFoundError``; ``SKETCHES_TPU_WINDOWED=0`` refuses
+    via the ``WindowedSketch`` constructor.
+    """
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    try:
+        wsk = _restore_windowed_inner(
+            path, clock=clock, mesh=mesh, value_axis=value_axis,
+            stream_axis=stream_axis, engine=engine,
+        )
+    except (FileNotFoundError, CheckpointCorrupt):
+        raise
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"windowed checkpoint {path!r} failed to restore"
+            f" ({type(e).__name__}: {e})"
+        ) from e
+    if _t0 is not None:
+        telemetry.finish_span("checkpoint.restore_s", _t0)
+    return wsk
+
+
+def _restore_windowed_inner(
+    path, *, clock, mesh, value_axis, stream_axis, engine
+):
+    from sketches_tpu.windows import WindowConfig, WindowedSketch, _Bucket
+
+    with np.load(path) as data:
+        if "__window__" not in data.files:
+            raise CheckpointCorrupt(
+                f"checkpoint {path!r} is not a windowed checkpoint"
+                " (no __window__ member); use restore() instead"
+            )
+        meta_json = bytes(data["__window__"]).decode()
+        meta = json.loads(meta_json)
+        spec = _spec_from_meta(meta["spec"])
+        cfg = meta["config"]
+        config = WindowConfig(
+            slices_s=tuple(cfg["slices_s"]),
+            lengths=tuple(cfg["lengths"]),
+            collapse_levels=(
+                None if cfg["collapse_levels"] is None
+                else tuple(cfg["collapse_levels"])
+            ),
+        )
+        n_buckets = len(meta["buckets"])
+        fields = (
+            _FIELDS + ["level"] if spec.backend == "uniform_collapse"
+            else list(_MOMENT_FIELDS) if spec.backend == "moment"
+            else list(_FIELDS)
+        )
+        arrays_np = {}
+        for k in range(n_buckets):
+            for name in fields:
+                key = f"b{k}.{name}"
+                if key not in data.files:
+                    raise CheckpointCorrupt(
+                        f"windowed checkpoint {path!r} is missing"
+                        f" bucket array {key!r}"
+                    )
+                arrays_np[key] = np.asarray(data[key])
+        if "__checksum__" in data.files:
+            stored = bytes(data["__checksum__"]).decode()
+            got = _digest(meta_json, arrays_np)
+            if got != stored:
+                raise CheckpointCorrupt(
+                    f"windowed checkpoint {path!r} checksum mismatch"
+                    f" (stored {stored[:12]}..., recomputed"
+                    f" {got[:12]}...): content corrupted after write"
+                )
+        wsk = WindowedSketch(
+            int(meta["n_streams"]), spec=spec, config=config,
+            clock=clock, mesh=mesh, value_axis=value_axis,
+            stream_axis=stream_axis, engine=engine,
+        )
+        for k, bm in enumerate(meta["buckets"]):
+            arrays = {
+                name: jnp.asarray(arrays_np[f"b{k}.{name}"])
+                for name in fields
+            }
+            state = _arrays_to_backend_state(spec, arrays)
+            if integrity._ACTIVE and f"__fp_b{k}__" in data.files:
+                integrity.verify_restore(
+                    spec, state, np.asarray(data[f"__fp_b{k}__"]),
+                    seam="checkpoint.restore_windowed",
+                )
+            if bm["live"]:
+                wsk._set_live_state(state)
+                wsk._live_id = int(bm["id"])
+                wsk._live_mass = float(bm["mass"])
+            else:
+                wsk._rungs[int(bm["rung"])][int(bm["id"])] = _Bucket(
+                    rung=int(bm["rung"]), id=int(bm["id"]),
+                    state=state, mass=float(bm["mass"]),
+                )
+        wsk._total = float(meta["total"])
+        wsk._retired = float(meta["retired"])
+        wsk._rotations = int(meta.get("rotations", 0))
+        wsk._ladder_collapses = int(meta.get("ladder_collapses", 0))
+        wsk._cur = None if meta["cur"] is None else int(meta["cur"])
+    return wsk

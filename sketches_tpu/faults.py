@@ -50,6 +50,10 @@ site                    simulates
 ``serve.queue_overflow``  forced admission-queue overflow in the serving
                         tier (raises at admission; the request must be
                         shed with a structured error, never hang)
+``window.rotate_torn``  a windowed-ring rotation interrupted between the
+                        retirement plan and the commit (raises at the
+                        rotation seam; the ring, ledger, and live bucket
+                        must survive bit-identical -- rotation is atomic)
 ======================  ====================================================
 
 Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
@@ -94,6 +98,7 @@ __all__ = [
     "SERVE_STRAGGLER",
     "SERVE_CACHE_POISON",
     "SERVE_QUEUE_OVERFLOW",
+    "WINDOW_ROTATE_TORN",
     "SITES",
     "arm",
     "disarm",
@@ -127,6 +132,7 @@ STATE_BITFLIP = "state.bitflip"
 SERVE_STRAGGLER = "serve.straggler"
 SERVE_CACHE_POISON = "serve.cache_poison"
 SERVE_QUEUE_OVERFLOW = "serve.queue_overflow"
+WINDOW_ROTATE_TORN = "window.rotate_torn"
 
 SITES = (
     NATIVE_LOAD,
@@ -143,6 +149,7 @@ SITES = (
     SERVE_STRAGGLER,
     SERVE_CACHE_POISON,
     SERVE_QUEUE_OVERFLOW,
+    WINDOW_ROTATE_TORN,
 )
 
 #: Fast-path guard: seams check this module flag before calling

@@ -80,6 +80,8 @@ __all__ = [
     "check",
     "check_state",
     "check_host",
+    "check_window",
+    "verify_window",
     "verify",
     "verify_state",
     "fingerprint",
@@ -1126,3 +1128,78 @@ def repair(spec, state) -> Tuple[Any, IntegrityReport]:
 _env = registry.get(registry.INTEGRITY)
 if _env and _env != "0":  # pragma: no cover - exercised via subprocess in CI
     arm("quarantine" if _env in ("quarantine", "report") else "raise")
+
+
+# ---------------------------------------------------------------------------
+# Windowed rings: ledger + per-bucket invariants
+# ---------------------------------------------------------------------------
+
+
+def check_window(wsk, seam: str = "window") -> IntegrityReport:
+    """Invariant-check a ``WindowedSketch``: the exact mass ledger plus
+    every live bucket's state -> an :class:`IntegrityReport`.
+
+    Two windowed-specific invariants, both compared with ``==`` (the
+    ledger is exact by contract, never approximate):
+
+    * ``window_ledger`` -- ``total_mass == sum(live bucket masses) +
+      retired_mass``;
+    * ``window_bucket_mass`` -- each bucket's ledger entry equals the
+      device-side mass of its state (``count`` summed over streams).
+
+    Every bucket state additionally runs the backend's own
+    :func:`check_state` invariants (violations fold into the same
+    report, stream indices preserved).  A clean ring returns a falsy
+    report; this is the checker -- callers wanting the armed
+    raise/quarantine policy route the report through
+    :func:`verify_window`.
+    """
+    buckets = wsk.buckets()
+    report = IntegrityReport(seam=seam, n_streams=wsk.n_streams)
+    live_sum = sum(m for _, _, m in buckets)
+    if wsk.total_mass != live_sum + wsk.retired_mass:
+        report.add(
+            -1, "window_ledger",
+            f"total {wsk.total_mass:g} != live {live_sum:g} +"
+            f" retired {wsk.retired_mass:g}",
+        )
+    device = wsk.device_masses()
+    for rung, bid, mass in buckets:
+        got = device.get((rung, bid))
+        if got is None or got != mass:
+            report.add(
+                -1, "window_bucket_mass",
+                f"bucket (rung {rung}, id {bid}) ledger {mass:g} !="
+                f" device {got}",
+            )
+    for rung in range(wsk.config.n_rungs):
+        for bid, b in sorted(wsk._rungs[rung].items()):
+            sub = check_state(
+                wsk.spec, b.state, seam=f"{seam}.bucket[{rung},{bid}]"
+            )
+            for v in sub.violations:
+                report.add(v.stream, v.invariant, v.detail)
+            report.n_violations += sub.n_violations - len(sub.violations)
+    if wsk._live_id is not None:
+        sub = check_state(
+            wsk.spec, wsk._snapshot_state(wsk._live.state),
+            seam=f"{seam}.live",
+        )
+        for v in sub.violations:
+            report.add(v.stream, v.invariant, v.detail)
+        report.n_violations += sub.n_violations - len(sub.violations)
+    return report
+
+
+def verify_window(
+    wsk, *, seam: str = "window", errors: Optional[str] = None
+) -> IntegrityReport:
+    """Check a windowed ring (:func:`check_window`) and apply the armed
+    policy -- raises :class:`IntegrityError` on violations in
+    ``"raise"`` mode, records and returns the report in
+    ``"quarantine"`` mode; a clean ring returns a falsy report."""
+    _t0 = telemetry.clock() if telemetry._ACTIVE else None
+    report = check_window(wsk, seam=seam)
+    if _t0 is not None:
+        telemetry.finish_span("integrity.check_s", _t0, seam=seam)
+    return _record(report, errors)

@@ -306,7 +306,7 @@ class SketchServer:
 
     def add_tenant(
         self, name: str, n_streams: int, *, mesh=None, value_axis=None,
-        stream_axis=None, **kwargs,
+        stream_axis=None, window=None, **kwargs,
     ):
         """Register tenant ``name`` with its own isolated facade (and
         therefore its own ``SketchSpec``) -> the facade.
@@ -318,14 +318,37 @@ class SketchServer:
         fleet behind the serving tier; its read path (fingerprints,
         fused dispatch, the breaker/deadline tier exclusions) is
         API-identical, and :meth:`reshard_tenant` can later resize its
-        mesh live without poisoning the cache.  Re-registering an
-        existing name raises ``SpecError`` -- tenant state is never
-        silently replaced.
+        mesh live without poisoning the cache.  Passing ``window=``
+        (``True`` for the default 5s -> 1m -> 1h ladder, or a
+        ``sketches_tpu.windows.WindowConfig``) backs the tenant with a
+        :class:`~sketches_tpu.windows.WindowedSketch` on the serving
+        clock: time-scoped reads then go through :meth:`quantile` with
+        ``window=...`` (the queued :meth:`submit` path refuses windowed
+        tenants loudly), writes ride :meth:`ingest` unchanged, and
+        ``SKETCHES_TPU_WINDOWED=0`` refuses at registration.
+        Re-registering an existing name raises ``SpecError`` -- tenant
+        state is never silently replaced.
         """
         with self._lock:
             if name in self._tenants:
                 raise SpecError(f"tenant {name!r} already registered")
-            if mesh is not None or value_axis is not None \
+            if window is not None:
+                from sketches_tpu.windows import WindowConfig, WindowedSketch
+
+                config = None if window is True else window
+                if config is not None and not isinstance(
+                    config, WindowConfig
+                ):
+                    raise SpecError(
+                        "window= takes True (default ladder) or a"
+                        f" WindowConfig; got {type(window).__name__}"
+                    )
+                facade = WindowedSketch(
+                    n_streams, config=config, clock=self._clock,
+                    mesh=mesh, value_axis=value_axis,
+                    stream_axis=stream_axis, **kwargs,
+                )
+            elif mesh is not None or value_axis is not None \
                     or stream_axis is not None:
                 from sketches_tpu.parallel import (
                     DistributedDDSketch,
@@ -614,6 +637,12 @@ class SketchServer:
         as :meth:`submit` documents."""
         with self._lock:
             t = self._tenant(name)
+            if self._is_windowed(t):
+                raise SpecError(
+                    f"tenant {name!r} is time-windowed: query it with"
+                    " quantile(tenant, qs, window=...) -- the queued"
+                    " submit/flush path has no window semantics"
+                )
             self._stats["requests"] += 1
             now = self._clock()
             _trc = tracing.new_trace() if tracing._ACTIVE else None
@@ -991,6 +1020,136 @@ class SketchServer:
                                 trace=tk.trace, source="dispatch",
                             )
             return out
+
+    @staticmethod
+    def _is_windowed(t: _Tenant) -> bool:
+        # Cheap structural probe (no import unless windows is loaded):
+        # WindowedSketch is the only facade carrying a window_plan.
+        return hasattr(t.facade, "window_plan")
+
+    def quantile(
+        self,
+        name: str,
+        quantiles: Sequence[float],
+        window: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ) -> ServeResult:
+        """``quantile(tenant, qs, window=W)``: the time-scoped read --
+        "p99 over the last W seconds" -> a :class:`ServeResult`.
+
+        For a windowed tenant the answer is ONE fused stacked-merge
+        dispatch over the buckets covering ``[now - W, now)``
+        (``window=None`` covers the whole retained horizon), cached
+        under ``(tenant, covered-bucket fingerprint-set digest, qs)``:
+        a rotation or an ingest changes the covered set's fingerprints,
+        so stale entries MISS -- they can never serve a stale-wrong
+        window (hits are re-verified against the live fingerprint +
+        payload checksum and poisoned entries quarantine exactly like
+        the unwindowed cache).  For a plain tenant ``window`` must be
+        None (``SpecError``) and the call is :meth:`query`.  Spent
+        deadline budgets raise :class:`DeadlineExceeded`; late answers
+        are returned but counted; unknown tenants raise ``SpecError``.
+        """
+        t = self._tenant(name)
+        if not self._is_windowed(t):
+            if window is not None:
+                raise SpecError(
+                    f"tenant {name!r} is not time-windowed: register it"
+                    " with add_tenant(..., window=...) to serve"
+                    " window-scoped quantiles"
+                )
+            return self.query(name, quantiles, deadline_s)
+        qs = tuple(sorted(float(q) for q in quantiles))
+        if not qs:
+            raise SketchValueError("a request needs at least one quantile")
+        with self._lock:
+            self._stats["requests"] += 1
+            now = self._clock()
+            _trc = tracing.new_trace() if tracing._ACTIVE else None
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("serve.requests")
+            budget = (
+                self.config.default_deadline_s
+                if deadline_s is None else float(deadline_s)
+            )
+            if budget <= 0:
+                self._stats["deadline_misses"] += 1
+                resilience.bump("serve.deadline_misses")
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("serve.deadline_misses")
+                raise DeadlineExceeded(
+                    f"window query for tenant {name!r} arrived with a"
+                    f" spent deadline budget ({budget:g}s)"
+                )
+            plan = t.facade.window_plan(window)
+            fp = plan.fingerprint
+            digest = plan.digest
+            key = (t.name, digest, qs)
+            if self._cache_enabled:
+                entry = self._cache.get(key)
+                if entry is not None:
+                    if faults._ACTIVE:
+                        flip = faults.cache_poison_flip(entry.values.nbytes)
+                        if flip is not None:
+                            buf = np.ascontiguousarray(entry.values).copy()
+                            view = buf.view(np.uint8).reshape(-1)
+                            view[flip[0]] ^= np.uint8(1 << flip[1])
+                            entry.values = buf
+                    live_ok = entry.fp.shape == fp.shape and bool(
+                        np.array_equal(entry.fp, fp)
+                    )
+                    sum_ok = entry.checksum == _payload_checksum(
+                        entry.fp, entry.values
+                    )
+                    if live_ok and sum_ok:
+                        self._stats["cache_hits"] += 1
+                        if _trc is not None:
+                            tracing.record_event(
+                                "serve.cache.hit", ctx=_trc, tenant=name
+                            )
+                        if telemetry._ACTIVE:
+                            telemetry.counter_inc("serve.cache.hits")
+                            telemetry.observe(
+                                "serve.request_s", self._clock() - now,
+                                trace=_trc, source="cache",
+                            )
+                        return ServeResult(
+                            values=entry.values.copy(), tier="cache"
+                        )
+                    self._quarantine(key, ctx=_trc)
+                self._stats["cache_misses"] += 1
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("serve.cache.misses")
+            values = np.asarray(t.facade.query_plan(plan, qs))
+            self._stats["dispatches"] += 1
+            if self._cache_enabled:
+                if key not in self._cache:
+                    self._cache_order.append(key)
+                self._cache[key] = _CacheEntry(fp, values, "window")
+                while len(self._cache_order) > self.config.cache_capacity:
+                    old = self._cache_order.pop(0)
+                    self._cache.pop(old, None)
+            done = self._clock()
+            missed = done > now + budget
+            if missed:
+                self._stats["deadline_misses"] += 1
+                resilience.bump("serve.deadline_misses")
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("serve.deadline_misses")
+            if _trc is not None:
+                tracing.record_event(
+                    "serve.dispatch", ctx=_trc, tenant=name,
+                    tier="window", hedged=False,
+                    covered=plan.n_covered,
+                )
+            if telemetry._ACTIVE:
+                telemetry.observe(
+                    "serve.request_s", done - now, trace=_trc,
+                    source="dispatch",
+                )
+            return ServeResult(
+                values=values, tier="window", deadline_missed=missed
+            )
 
     def query(
         self,

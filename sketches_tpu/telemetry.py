@@ -350,6 +350,20 @@ _DECLARED = (
     Metric("serve.batch_s", "histogram", "sketches_tpu.serve",
            "Fused flush dispatch wall time per tenant group (label:"
            " tier)."),
+    Metric("window.rotations", "counter", "sketches_tpu.windows",
+           "Windowed-ring bucket rotations: live time-slice buckets"
+           " frozen into the ring as the clock crossed a slice"
+           " boundary."),
+    Metric("window.retired_mass", "counter", "sketches_tpu.windows",
+           "Exact mass dropped off the last ladder rung by windowed"
+           " bucket retirement (the ledger's retired side)."),
+    Metric("window.ladder_collapses", "counter", "sketches_tpu.windows",
+           "Collapse-on-retire applications: buckets brought to a"
+           " coarser rung's declared collapse level as they aged down"
+           " the ladder."),
+    Metric("window.covered_buckets", "gauge", "sketches_tpu.windows",
+           "Buckets covered by the most recent window query (the fused"
+           " stacked-merge dispatch's arity)."),
 )
 
 #: Every declared metric by name (static inventory + runtime

@@ -1,0 +1,984 @@
+"""Time-windowed quantiles: "p99 over the last 5 minutes" as a query.
+
+Every real dashboard query against a quantile fleet is time-scoped, and
+DDSketch's full mergeability (PAPER.md) makes windowing nearly free: a
+window query is just a merge over the bucket sketches that cover it.
+This module is that composition, built entirely from seams earlier
+rounds landed:
+
+* **Ring of time-slice buckets.**  A :class:`WindowedSketch` routes
+  ingest to the *current* bucket of a ring of ``B`` time slices (one
+  backend sketch per slice, any ``SketchSpec`` backend -- dense,
+  ``uniform_collapse``, ``moment``, or a mesh-sharded distributed
+  fleet).  The clock is injectable (defaults to ``telemetry.clock``),
+  so every rotation/query replays exactly under a virtual clock -- no
+  code here sleeps or reads wall time.
+* **Window queries are ONE fused stacked-merge dispatch.**  A query for
+  ``quantile(q, window=W)`` selects the buckets whose time slices
+  intersect ``[now - W, now)`` and folds them with the backend's own
+  merge algebra inside one jitted dispatch (the serve tier's same-spec
+  stacking shape): dense buckets fold through
+  ``batched.merge_aligned``, adaptive buckets through
+  ``backends.uniform.merge`` (levels align first), moment buckets
+  through the elementwise ``backends.moment.merge``.  The answer is
+  bit-identical to a host-side sequential merge of the covered buckets
+  -- the oracle the tests pin.
+* **Eviction is rotation, with an exact mass ledger.**  When a bucket
+  ages out of its ring it *retires* into the next rung of a
+  hierarchical coarsening ladder (e.g. 5s -> 1m -> 1h slices): its
+  mass merges into the coarser bucket covering its interval, optionally
+  collapsing first (``uniform_collapse`` backend:
+  ``collapse_to(rung level)``, so ``effective_alpha`` per rung is the
+  DECLARED accuracy contract -- old data gracefully loses precision
+  instead of space).  Mass falling off the last rung is dropped and
+  recorded.  The per-bucket mass ledger is **exact**: every ingested
+  unit of weight is in exactly one live bucket or in ``retired_mass``,
+  and the chaos campaign asserts the ledger with ``==``, never
+  approximately.
+* **Atomic rotation.**  A rotation plans functionally (new ring dicts,
+  new folded states) and commits by reference swap; the
+  ``window.rotate_torn`` fault site fires between plan and commit, so
+  a torn rotation leaves the ring, the ledger, and the live bucket
+  bit-identical (chaos-proven).
+
+Failure modes: constructing a :class:`WindowedSketch` (or querying one)
+with ``SKETCHES_TPU_WINDOWED=0`` raises ``SpecError`` -- the kill
+switch refuses loudly; invalid ladder configurations (non-divisible
+slice widths, non-positive lengths, collapse levels on a non-adaptive
+backend) raise ``SpecError`` at construction; a window with no covered
+mass answers NaN exactly like an empty sketch; merging mismatched
+configs raises ``UnequalSketchParametersError``; a torn rotation
+(injected) raises ``InjectedFault`` with nothing mutated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sketches_tpu import batched, faults, integrity, telemetry
+from sketches_tpu.analysis import registry
+from sketches_tpu.batched import SketchSpec
+from sketches_tpu.resilience import (
+    SketchValueError,
+    SpecError,
+    UnequalSketchParametersError,
+)
+
+__all__ = [
+    "WindowConfig",
+    "WindowedSketch",
+    "VirtualClock",
+    "DEFAULT_LADDER",
+]
+
+
+class VirtualClock:
+    """A deterministic, manually-advanced clock for tests and drills.
+
+    ``clock()`` semantics (monotone seconds) without any wall-time read:
+    call the instance to read ``t``, :meth:`advance` to move it.  Never
+    raises; time never goes backwards (negative deltas raise
+    ``SketchValueError`` -- a backwards window clock would silently
+    re-open retired buckets).
+    """
+
+    __slots__ = ("t",)
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        """Move the clock forward by ``dt`` seconds -> the new time."""
+        if dt < 0:
+            raise SketchValueError("VirtualClock cannot run backwards")
+        self.t += float(dt)
+        return self.t
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowConfig:
+    """The ring/ladder layout: per-rung slice widths and ring lengths.
+
+    ``slices_s[r]`` is rung ``r``'s time-slice width in seconds (rung 0
+    is the fine rung ingest lands in); ``lengths[r]`` is how many
+    slices rung ``r`` retains before a bucket retires into rung
+    ``r + 1`` (or, off the last rung, is dropped with its mass recorded
+    in ``retired_mass``).  ``collapse_levels[r]`` (``uniform_collapse``
+    backend only) is the collapse level a bucket is brought to when it
+    *enters* rung ``r`` -- the rung's declared ``effective_alpha``
+    contract.
+
+    Failure modes: non-positive widths/lengths, a coarser slice that is
+    not an integer multiple of the finer one (buckets must nest), a
+    ``collapse_levels`` tuple of the wrong length or decreasing order
+    all raise ``SpecError`` at construction.
+    """
+
+    slices_s: Tuple[float, ...] = (5.0, 60.0, 3600.0)
+    lengths: Tuple[int, ...] = (12, 60, 24)
+    collapse_levels: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        slices = tuple(float(s) for s in self.slices_s)
+        lengths = tuple(int(n) for n in self.lengths)
+        object.__setattr__(self, "slices_s", slices)
+        object.__setattr__(self, "lengths", lengths)
+        if not slices or len(slices) != len(lengths):
+            raise SpecError(
+                "WindowConfig needs one (slice width, ring length) pair"
+                f" per rung; got {len(slices)} widths, {len(lengths)}"
+                " lengths"
+            )
+        if any(s <= 0 for s in slices) or any(n <= 0 for n in lengths):
+            raise SpecError("slice widths and ring lengths must be positive")
+        for fine, coarse in zip(slices, slices[1:]):
+            ratio = coarse / fine
+            if coarse <= fine or abs(ratio - round(ratio)) > 1e-9:
+                raise SpecError(
+                    "ladder slices must be strictly coarsening integer"
+                    f" multiples; got {fine}s -> {coarse}s"
+                )
+        if self.collapse_levels is not None:
+            levels = tuple(int(v) for v in self.collapse_levels)
+            object.__setattr__(self, "collapse_levels", levels)
+            if len(levels) != len(slices):
+                raise SpecError(
+                    "collapse_levels needs one level per rung; got"
+                    f" {len(levels)} for {len(slices)} rungs"
+                )
+            if any(v < 0 for v in levels) or list(levels) != sorted(levels):
+                raise SpecError(
+                    "collapse_levels must be non-negative and"
+                    " non-decreasing (coarser rungs never regain"
+                    " resolution)"
+                )
+
+    @property
+    def n_rungs(self) -> int:
+        return len(self.slices_s)
+
+    def horizon_s(self) -> float:
+        """Total retained history in seconds (sum of every rung's span);
+        never raises."""
+        return float(
+            sum(s * n for s, n in zip(self.slices_s, self.lengths))
+        )
+
+
+#: The dashboard-shaped default ladder: 12 x 5 s (the live minute),
+#: 60 x 1 m (the hour), 24 x 1 h (the day).
+DEFAULT_LADDER = WindowConfig()
+
+
+@dataclasses.dataclass
+class _Bucket:
+    """One frozen time-slice bucket: its ring position, its backend
+    state pytree, and its exact mass ledger entry.  ``fp`` memoizes the
+    content fingerprint (frozen states are immutable, so once computed
+    it never changes)."""
+
+    rung: int
+    id: int
+    state: Any
+    mass: float
+    fp: Optional[np.ndarray] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowPlan:
+    """One resolved window query: the covered buckets' states (frozen
+    at plan time, so a rotation between plan and dispatch cannot skew
+    the answer), their combined content fingerprint, and the cache-key
+    digest derived from the covered-bucket fingerprint *set*.  Obtained
+    from :meth:`WindowedSketch.window_plan`; an empty plan (no covered
+    mass) answers NaN.
+
+    Validity: a plan must be consumed before the ring's next WRITE --
+    ingest donates the live bucket's device buffers (the engines'
+    in-place update discipline), so a plan held across an ``add``
+    may reference deleted buffers and the dispatch then fails loudly
+    (``RuntimeError``), never answers silently wrong.  The serving
+    tier plans and dispatches under one lock, so this cannot happen
+    there."""
+
+    window_s: Optional[float]
+    now: float
+    keys: Tuple[Tuple[int, int], ...]  # (rung, bucket id), coverage order
+    states: Tuple[Any, ...]
+    fingerprint: np.ndarray
+    digest: bytes
+
+    @property
+    def n_covered(self) -> int:
+        return len(self.states)
+
+
+#: Process-wide fused-fold cache: one ``{mode: jitted callable}`` per
+#: spec (jit retraces per covered-bucket arity under the same callable,
+#: so every ring sharing a spec shares every compilation).  Dense specs
+#: carry two modes -- ``"aligned"`` (all covered windows share one
+#: per-stream offset: elementwise merge, no recenter scatters) and
+#: ``"general"`` (drifted windows: ``merge_aligned`` chain) -- chosen
+#: HOST-SIDE from the plan's offsets; the oracle applies the identical
+#: choice, so bit-identity is by symmetry, not by luck.
+_FOLD_CACHE: Dict[SketchSpec, Dict[str, Callable]] = {}
+
+
+def _plan_aligned(spec: SketchSpec, states) -> bool:
+    """Whether every covered dense state shares one per-stream window
+    offset (the common case: buckets that never recentered apart).
+    Non-dense backends answer False (their folds self-align)."""
+    if spec.backend != "dense" or len(states) < 2:
+        return spec.backend == "dense"
+    first = np.asarray(jax.device_get(states[0].key_offset))
+    for st in states[1:]:
+        if not np.array_equal(
+            first, np.asarray(jax.device_get(st.key_offset))
+        ):
+            return False
+    return True
+
+
+def _fold_for(spec: SketchSpec) -> Dict[str, Callable]:
+    fns = _FOLD_CACHE.get(spec)
+    if fns is not None:
+        return fns
+    if spec.backend == "uniform_collapse":
+        from sketches_tpu.backends import uniform
+
+        def fold(states, qs):
+            acc = states[0]
+            for st in states[1:]:
+                acc = uniform.merge(spec, acc, st)
+            return uniform.quantile(spec, acc, qs)
+
+        fns = {"general": jax.jit(fold)}
+    elif spec.backend == "moment":
+        from sketches_tpu.backends import moment
+
+        def merge_chain(states):
+            acc = states[0]
+            for st in states[1:]:
+                acc = moment.merge(spec, acc, st)
+            return acc
+
+        merged = jax.jit(merge_chain)
+
+        def host_solve(states, qs):  # host maxent after one fused merge
+            return moment.quantile(spec, merged(states), qs)
+
+        fns = {"general": host_solve}
+    else:
+
+        def fold_general(states, qs):
+            acc = states[0]
+            for st in states[1:]:
+                acc = batched.merge_aligned(spec, acc, st)
+            return batched.quantile(spec, acc, qs)
+
+        def fold_aligned(states, qs):
+            acc = states[0]
+            for st in states[1:]:
+                acc = batched.merge(spec, acc, st)
+            return batched.quantile(spec, acc, qs)
+
+        fns = {
+            "general": jax.jit(fold_general),
+            "aligned": jax.jit(fold_aligned),
+        }
+    _FOLD_CACHE[spec] = fns
+    return fns
+
+
+def _fold_mode(spec: SketchSpec, states) -> str:
+    fns = _fold_for(spec)
+    if "aligned" in fns and _plan_aligned(spec, states):
+        return "aligned"
+    return "general"
+
+
+def _batch_mass(spec: SketchSpec, values, weights) -> float:
+    """Exact host-side mass of one ingest batch, matching the device
+    tier's ``count`` delta: the sum of positive weights (``w <= 0``
+    lanes are padding; NaN values still count -- they land in the
+    zero path).  Integer bin mode truncates fractional weights exactly
+    as the device cast does.  Never raises on well-formed arrays."""
+    v = np.asarray(values)
+    if weights is None:
+        return float(v.size)
+    w = np.broadcast_to(
+        np.asarray(weights, np.float64), v.shape
+    )
+    live = w > 0
+    if spec.bins_integer:
+        return float(np.trunc(w[live]).sum())
+    return float(w[live].sum())
+
+
+class WindowedSketch:
+    """Per-tenant ring of time-slice bucket sketches with a coarsening
+    ladder (module docstring for the full design).
+
+    ``spec``/``**kwargs`` select the backend exactly like
+    :func:`sketches_tpu.backends.facade_for`; passing ``mesh``/
+    ``value_axis``/``stream_axis`` backs the live bucket with a
+    mesh-sharded ``DistributedDDSketch`` (dense backend only -- frozen
+    buckets are topology-free merged states, so they survive
+    :meth:`reshard` untouched).
+
+    Failure modes: ``SKETCHES_TPU_WINDOWED=0`` raises ``SpecError`` at
+    construction (loud refusal, one env read); ``collapse_levels`` on a
+    non-``uniform_collapse`` backend raises ``SpecError``;
+    :meth:`merge` across unequal specs/configs raises
+    ``UnequalSketchParametersError``; :meth:`reshard` of a
+    non-distributed ring raises ``SpecError``; empty windows answer
+    NaN; an injected torn rotation raises ``InjectedFault`` with the
+    ring left bit-identical.
+    """
+
+    def __init__(
+        self,
+        n_streams: int,
+        *,
+        spec: Optional[SketchSpec] = None,
+        config: Optional[WindowConfig] = None,
+        clock: Optional[Callable[[], float]] = None,
+        mesh=None,
+        value_axis=None,
+        stream_axis=None,
+        engine: str = "auto",
+        **kwargs,
+    ):
+        if not registry.enabled(registry.WINDOWED):
+            raise SpecError(
+                "time-windowed sketches are disabled"
+                " (SKETCHES_TPU_WINDOWED=0): refusing to construct a"
+                " WindowedSketch rather than silently serving"
+                " unwindowed answers"
+            )
+        self.config = config or DEFAULT_LADDER
+        if spec is None:
+            backend = kwargs.pop("backend", "dense")
+            spec = SketchSpec(backend=backend, **kwargs)
+            kwargs = {}
+        self.spec = spec
+        self._n_streams = int(n_streams)
+        self._clock = clock if clock is not None else telemetry.clock
+        if self.config.collapse_levels is not None:
+            if spec.backend != "uniform_collapse":
+                raise SpecError(
+                    "collapse_levels need backend='uniform_collapse';"
+                    f" got {spec.backend!r}"
+                )
+            if max(self.config.collapse_levels) > spec.max_collapses:
+                raise SpecError(
+                    "collapse_levels exceed spec.max_collapses"
+                    f" ({max(self.config.collapse_levels)} >"
+                    f" {spec.max_collapses})"
+                )
+        self._distributed = (
+            mesh is not None or value_axis is not None
+            or stream_axis is not None
+        )
+        self._engine = engine
+        self._mesh = mesh
+        self._dist_axes = (value_axis, stream_axis)
+        self._live = self._make_live()
+        self._live_id: Optional[int] = None
+        self._live_mass = 0.0
+        self._rungs: List[Dict[int, _Bucket]] = [
+            {} for _ in range(self.config.n_rungs)
+        ]
+        self._total = 0.0
+        self._retired = 0.0
+        self._rotations = 0
+        self._ladder_collapses = 0
+        self._cur: Optional[int] = None
+        self._version = 0  # bumped on every content change (live fp cache)
+        self._live_fp: Optional[Tuple[int, np.ndarray]] = None
+
+    # -- construction helpers ---------------------------------------------
+
+    def _make_live(self):
+        if self._distributed:
+            from sketches_tpu.parallel import DistributedDDSketch
+
+            value_axis, stream_axis = self._dist_axes
+            if self._mesh is None and value_axis is None \
+                    and stream_axis is None:
+                value_axis = "values"
+            return DistributedDDSketch(
+                self._n_streams, mesh=self._mesh, value_axis=value_axis,
+                stream_axis=stream_axis, spec=self.spec,
+                engine=self._engine,
+            )
+        from sketches_tpu.backends import facade_for
+
+        return facade_for(
+            self._n_streams, spec=self.spec, engine=self._engine
+        )
+
+    def _reset_live(self) -> None:
+        """Empty the live bucket's facade (cheap state swap for host
+        facades; a mesh-backed live bucket rebuilds on its current
+        mesh -- rotation cadence is seconds, so the rebuild is cold-path
+        by construction)."""
+        if self._distributed:
+            self._live = self._make_live()
+            return
+        self._live.state = self._empty_state()
+        if hasattr(self._live, "_auto_recenter_pending"):
+            # A fresh bucket re-centers its window on its first batch,
+            # exactly like a fresh facade would.
+            self._live._auto_recenter_pending = True
+
+    def _set_live_state(self, state) -> None:
+        """Assign merged content to the live bucket (merge path)."""
+        if self._distributed:
+            from sketches_tpu.parallel import DistributedDDSketch
+
+            value_axis, stream_axis = self._dist_axes
+            self._live = DistributedDDSketch.from_merged_state(
+                state, self.spec, mesh=self._mesh,
+                value_axis=value_axis or "values",
+                stream_axis=stream_axis, engine=self._engine,
+            )
+            return
+        self._live.state = state
+
+    def _snapshot_state(self, state):
+        """Freeze a bucket state for the ring.  Mesh-backed rings
+        normalize to host-committed (unsharded) arrays: frozen buckets
+        are topology-free by contract (they must survive reshard), and
+        the fused fold must stay bit-identical to the host-side oracle
+        -- sharded operands can compile to different (1-ULP) decode
+        fusions.  Host facades pass through untouched (their states are
+        already single-device)."""
+        if not self._distributed:
+            return state
+        return jax.tree.map(
+            lambda a: jnp.asarray(np.asarray(jax.device_get(a))), state
+        )
+
+    def _empty_state(self):
+        if self.spec.backend == "uniform_collapse":
+            from sketches_tpu.backends.uniform import AdaptiveState
+
+            return AdaptiveState(
+                base=batched.init(self.spec, self._n_streams),
+                level=jnp.zeros((self._n_streams,), jnp.int32),
+            )
+        if self.spec.backend == "moment":
+            from sketches_tpu.backends import moment
+
+            return moment.init(self.spec, self._n_streams)
+        return batched.init(self.spec, self._n_streams)
+
+    def _merge_states(self, a, b):
+        """Functional backend merge of two bucket states (pure).
+        Dense operands sharing one per-stream window merge elementwise
+        (the ladder-fold twin of the fused fold's aligned mode -- no
+        recenter rolls); drifted windows take ``merge_aligned``."""
+        if self.spec.backend == "uniform_collapse":
+            from sketches_tpu.backends import uniform
+
+            return uniform.merge(self.spec, a, b)
+        if self.spec.backend == "moment":
+            from sketches_tpu.backends import moment
+
+            return moment.merge(self.spec, a, b)
+        if _plan_aligned(self.spec, (a, b)):
+            return batched.merge(self.spec, a, b)
+        return batched.merge_aligned(self.spec, a, b)
+
+    # -- time arithmetic ---------------------------------------------------
+
+    def _id_at(self, rung: int, now: float) -> int:
+        return int(math.floor(now / self.config.slices_s[rung]))
+
+    def _interval(self, rung: int, bucket_id: int) -> Tuple[float, float]:
+        s = self.config.slices_s[rung]
+        return bucket_id * s, (bucket_id + 1) * s
+
+    # -- rotation ----------------------------------------------------------
+
+    def _roll(self, now: float) -> None:
+        """Advance the ring to ``now``: freeze an aged-out live bucket,
+        cascade retirements down the ladder, drop mass off the last
+        rung.  Plans functionally, injects ``window.rotate_torn``, then
+        commits by reference swap -- a tear mutates nothing."""
+        cur = self._id_at(0, now)
+        if cur == self._cur and (
+            self._live_id is None or self._live_id == cur
+        ):
+            return
+        freeze = (
+            self._live_id is not None and self._live_id != cur
+        )
+        new_rungs = [dict(r) for r in self._rungs]
+        rotations = 0
+        collapses = 0
+        retired = 0.0
+        retired_buckets: List[Tuple[int, int]] = []
+        if freeze:
+            state = self._snapshot_state(self._live.state)
+            new_rungs[0][self._live_id] = _Bucket(
+                rung=0, id=self._live_id, state=state,
+                mass=self._live_mass,
+            )
+            rotations += 1
+        # Cascade: rung r keeps its newest ``lengths[r]`` slices; older
+        # buckets fold into the coarser bucket covering their interval.
+        levels = self.config.collapse_levels
+        for r in range(self.config.n_rungs):
+            cur_r = self._id_at(r, now)
+            floor_id = cur_r - self.config.lengths[r] + 1
+            for bid in sorted(new_rungs[r]):
+                if bid >= floor_id:
+                    continue
+                b = new_rungs[r].pop(bid)
+                retired_buckets.append((r, bid))
+                if r + 1 >= self.config.n_rungs:
+                    retired += b.mass
+                    continue
+                state = b.state
+                if levels is not None and levels[r + 1] > 0:
+                    from sketches_tpu.backends import uniform
+
+                    state = uniform.collapse_to(
+                        self.spec, state,
+                        jnp.maximum(
+                            state.level, jnp.int32(levels[r + 1])
+                        ),
+                    )
+                    collapses += 1
+                start, _ = self._interval(r, bid)
+                tgt = self._id_at(r + 1, start)
+                existing = new_rungs[r + 1].get(tgt)
+                if existing is None:
+                    new_rungs[r + 1][tgt] = _Bucket(
+                        rung=r + 1, id=tgt, state=state, mass=b.mass
+                    )
+                else:
+                    new_rungs[r + 1][tgt] = _Bucket(
+                        rung=r + 1, id=tgt,
+                        state=self._merge_states(existing.state, state),
+                        mass=existing.mass + b.mass,
+                    )
+        if faults._ACTIVE:
+            # The adversary's window: everything above is functional
+            # (new dicts, new states); nothing observable has mutated
+            # yet, so a tear here proves rotation atomicity.
+            faults.inject(faults.WINDOW_ROTATE_TORN)
+        # -- commit (reference swaps only) --
+        self._rungs = new_rungs
+        if freeze:
+            self._reset_live()
+            self._live_id = None
+            self._live_mass = 0.0
+        self._cur = cur
+        self._rotations += rotations
+        self._ladder_collapses += collapses
+        self._retired += retired
+        self._version += 1
+        self._live_fp = None
+        if telemetry._ACTIVE:
+            if rotations:
+                telemetry.counter_inc("window.rotations", float(rotations))
+            if collapses:
+                telemetry.counter_inc(
+                    "window.ladder_collapses", float(collapses)
+                )
+            if retired:
+                telemetry.counter_inc("window.retired_mass", retired)
+
+    # -- write path --------------------------------------------------------
+
+    def add(self, values, weights=None) -> "WindowedSketch":
+        """Ingest ``values[n_streams, S]`` into the current time
+        slice's bucket; returns self for chaining.
+
+        Rotates first (the injectable clock decides the bucket), then
+        rides the backend facade's ingest unchanged -- engine ladder,
+        degradations, and refusals are exactly the facade's.  The exact
+        batch mass (positive weights; truncated in integer-bin mode)
+        lands in the bucket's ledger entry.
+        """
+        now = self._clock()
+        self._roll(now)
+        if self._live_id is None:
+            self._live_id = self._id_at(0, now)
+        self._live.add(values, weights)
+        mass = _batch_mass(self.spec, values, weights)
+        self._live_mass += mass
+        self._total += mass
+        self._version += 1
+        self._live_fp = None
+        return self
+
+    def merge(self, other: "WindowedSketch") -> "WindowedSketch":
+        """Fold another windowed ring into this one (same spec, same
+        ladder config, clock-aligned bucket ids) -- the cross-host fold
+        for windowed fleets: every bucket merges with its same-id twin
+        through the backend merge algebra, ledgers add exactly.
+        Unequal specs or configs raise
+        ``UnequalSketchParametersError``.
+        """
+        if other.spec != self.spec or other.config != self.config:
+            raise UnequalSketchParametersError(
+                "cannot merge windowed sketches with different specs or"
+                " ladder configs"
+            )
+        for r in range(self.config.n_rungs):
+            for bid, b in sorted(other._rungs[r].items()):
+                mine = self._rungs[r].get(bid)
+                if mine is None:
+                    self._rungs[r][bid] = _Bucket(
+                        rung=r, id=bid, state=b.state, mass=b.mass
+                    )
+                else:
+                    self._rungs[r][bid] = _Bucket(
+                        rung=r, id=bid,
+                        state=self._merge_states(mine.state, b.state),
+                        mass=mine.mass + b.mass,
+                    )
+        if other._live_id is not None:
+            if self._live_id is None:
+                self._live_id = other._live_id
+                self._set_live_state(other._live.state)
+                self._live_mass = other._live_mass
+            elif self._live_id == other._live_id:
+                self._set_live_state(
+                    self._merge_states(
+                        self._live.state, other._live.state
+                    )
+                )
+                self._live_mass += other._live_mass
+            else:
+                # Different current slices: the other's live bucket is
+                # frozen history from this ring's point of view.
+                self._rungs[0][other._live_id] = _Bucket(
+                    rung=0, id=other._live_id,
+                    state=other._live.state, mass=other._live_mass,
+                )
+        self._total += other._total
+        self._retired += other._retired
+        self._version += 1
+        self._live_fp = None
+        return self
+
+    def reshard(self, mesh=None, n_devices: Optional[int] = None,
+                *, live_mask=None):
+        """Resize a mesh-backed live bucket LIVE -> its
+        ``ReshardReport``; frozen buckets are topology-free merged
+        states and survive untouched.
+
+        Raises ``SpecError`` for a non-distributed ring; a torn reshard
+        (injected) raises and leaves the live fleet bit-identical --
+        reshard stays atomic even mid-rotation.  Dropped mass (dead
+        shards) is subtracted from the live bucket's ledger entry and
+        from ``total_mass`` exactly, so the ledger survives lossy
+        reshards too.
+        """
+        if not self._distributed:
+            raise SpecError(
+                "reshard needs a mesh-backed WindowedSketch (pass"
+                " mesh=/value_axis= at construction)"
+            )
+        new_facade, report = self._live.reshard(
+            mesh=mesh, n_devices=n_devices, live_mask=live_mask
+        )
+        self._live = new_facade
+        self._mesh = getattr(new_facade, "_sketch_mesh", self._mesh)
+        if report.n_dead:
+            dropped = float(
+                np.asarray(report.dropped_count, np.float64).sum()
+            )
+            self._live_mass -= dropped
+            self._total -= dropped
+        self._version += 1
+        self._live_fp = None
+        return report
+
+    # -- read path ---------------------------------------------------------
+
+    def _covered(
+        self, window_s: Optional[float], now: float
+    ) -> List[Tuple[int, int, Any, Optional[_Bucket]]]:
+        """Buckets whose time slice intersects ``[now - W, now)`` in
+        deterministic (start time, rung) order -> list of
+        ``(rung, id, state, bucket-or-None-for-live)``."""
+        t0 = -math.inf if window_s is None else now - float(window_s)
+        out = []
+        for r in range(self.config.n_rungs):
+            for bid, b in self._rungs[r].items():
+                start, end = self._interval(r, bid)
+                if end > t0 and start <= now:
+                    out.append((start, r, bid, b.state, b))
+        if self._live_id is not None:
+            # ``start <= now``: the current slice's bucket starts AT the
+            # boundary when now sits exactly on it -- data ingested "right
+            # now" is always part of "the last W seconds".
+            start, end = self._interval(0, self._live_id)
+            if end > t0 and start <= now:
+                out.append((
+                    start, 0, self._live_id,
+                    self._snapshot_state(self._live.state), None,
+                ))
+        out.sort(key=lambda e: (e[0], e[1]))
+        return [(r, bid, st, b) for _, r, bid, st, b in out]
+
+    def _bucket_fp(self, bucket: Optional[_Bucket], state) -> np.ndarray:
+        if bucket is not None:
+            if bucket.fp is None:
+                bucket.fp = integrity.fingerprint(self.spec, bucket.state)
+            return bucket.fp
+        cached = self._live_fp
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        fp = integrity.fingerprint(self.spec, state)
+        self._live_fp = (self._version, fp)
+        return fp
+
+    def window_plan(self, window_s: Optional[float] = None) -> WindowPlan:
+        """Resolve a window query: rotate, select the covered buckets,
+        and derive the fingerprint-set digest -> a :class:`WindowPlan`.
+
+        The digest is the cache-key contract the serving tier keys on:
+        it hashes every covered bucket's ``(rung, id, fingerprint)``,
+        so a rotation, an ingest, or any content change moves it -- a
+        stale cache entry can only MISS, never read stale-wrong.  An
+        empty coverage yields a plan whose query answers NaN.
+        """
+        now = self._clock()
+        self._roll(now)
+        covered = self._covered(window_s, now)
+        fps = [self._bucket_fp(b, st) for (_, _, st, b) in covered]
+        h = hashlib.sha256()
+        h.update(b"window")
+        for (r, bid, _, _), fp in zip(covered, fps):
+            h.update(np.int64(r).tobytes())
+            h.update(np.int64(bid).tobytes())
+            h.update(np.ascontiguousarray(fp).tobytes())
+        fingerprint = (
+            np.concatenate([np.atleast_1d(f) for f in fps])
+            if fps else np.zeros((0,), np.float64)
+        )
+        if telemetry._ACTIVE:
+            telemetry.gauge_set(
+                "window.covered_buckets", float(len(covered))
+            )
+        return WindowPlan(
+            window_s=window_s,
+            now=now,
+            keys=tuple((r, bid) for r, bid, _, _ in covered),
+            states=tuple(st for _, _, st, _ in covered),
+            fingerprint=fingerprint,
+            digest=h.digest(),
+        )
+
+
+    def query_plan(self, plan: WindowPlan, quantiles: Sequence[float]):
+        """Answer ``quantiles`` over a resolved :class:`WindowPlan` ->
+        ``[n_streams, Q]`` (NaN for empty coverage / empty streams).
+        The plan's states are frozen references, so a rotation between
+        planning and dispatch cannot change the answer."""
+        qs = tuple(float(q) for q in quantiles)
+        if not plan.states:
+            return np.full(
+                (self._n_streams, len(qs)), np.nan,
+                np.dtype(jnp.dtype(self.spec.dtype).name),
+            )
+        mode = _fold_mode(self.spec, plan.states)
+        return _fold_for(self.spec)[mode](
+            plan.states, jnp.asarray(qs, self.spec.dtype)
+        )
+
+    def quantile(
+        self, quantiles: Sequence[float],
+        window: Optional[float] = None,
+    ):
+        """``quantile(qs, window=W)``: the fused window query ->
+        ``[n_streams, Q]``.
+
+        ``window=None`` covers the whole retained horizon.  Bit-
+        identical to a host-side sequential merge of the covered
+        buckets (the tested oracle); empty windows/streams answer NaN.
+        """
+        return self.query_plan(self.window_plan(window), quantiles)
+
+    def get_quantile_values(self, quantiles: Sequence[float]):
+        """Facade-parity alias: full-horizon fused multi-quantile ->
+        ``[n_streams, Q]`` (NaN when empty)."""
+        return self.quantile(quantiles, window=None)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def n_streams(self) -> int:
+        return self._n_streams
+
+    @property
+    def total_mass(self) -> float:
+        """Exact mass ever ingested (minus reshard-dropped mass);
+        equals live ledger + ``retired_mass`` -- the invariant
+        :func:`sketches_tpu.integrity.check_window` verifies with
+        ``==``.  Never raises."""
+        return self._total
+
+    @property
+    def retired_mass(self) -> float:
+        """Exact mass dropped off the last ladder rung; never raises."""
+        return self._retired
+
+    def buckets(self) -> List[Tuple[int, int, float]]:
+        """The live ledger: ``(rung, bucket id, exact mass)`` per live
+        bucket (the current ingest bucket included), coverage-ordered.
+        Empty before the first ingest; never raises."""
+        out = [
+            (r, bid, b.mass)
+            for r in range(self.config.n_rungs)
+            for bid, b in sorted(self._rungs[r].items())
+        ]
+        if self._live_id is not None:
+            out.append((0, self._live_id, self._live_mass))
+        return sorted(out, key=lambda e: (e[0], e[1]))
+
+    def ledger(self) -> Dict[str, float]:
+        """The mass ledger summary: ``total`` (ever ingested),
+        ``live`` (sum of live bucket entries), ``retired`` (dropped off
+        the last rung), ``rotations``, ``ladder_collapses``.  The exact
+        invariant is ``total == live + retired``; never raises."""
+        live = sum(m for _, _, m in self.buckets())
+        return {
+            "total": self._total,
+            "live": live,
+            "retired": self._retired,
+            "rotations": float(self._rotations),
+            "ladder_collapses": float(self._ladder_collapses),
+        }
+
+    def rung_effective_alpha(self) -> List[float]:
+        """Each rung's declared accuracy contract: the worst-case
+        relative error of a bucket that has been coarsened into that
+        rung (``uniform_collapse``: ``effective_alpha`` at the rung's
+        collapse level; other backends: the spec alpha everywhere).
+        Never raises."""
+        if (
+            self.spec.backend == "uniform_collapse"
+            and self.config.collapse_levels is not None
+        ):
+            from sketches_tpu.backends.uniform import effective_alpha
+
+            return [
+                float(
+                    np.asarray(
+                        effective_alpha(
+                            self.spec, jnp.int32(level)
+                        )
+                    )
+                )
+                for level in self.config.collapse_levels
+            ]
+        return [
+            self.spec.relative_accuracy
+            for _ in range(self.config.n_rungs)
+        ]
+
+    def device_masses(self) -> Dict[Tuple[int, int], float]:
+        """Per-bucket device-side mass (sum of each bucket state's
+        ``count``) -- the audit-side twin of :meth:`buckets` the chaos
+        campaign compares with ``==``.  Forces a device fetch per
+        bucket; empty ring returns ``{}``; never raises."""
+        out: Dict[Tuple[int, int], float] = {}
+        for r in range(self.config.n_rungs):
+            for bid, b in self._rungs[r].items():
+                count = getattr(b.state, "count", None)
+                if count is None:  # pragma: no cover - defensive
+                    continue
+                out[(r, bid)] = float(
+                    np.asarray(jax.device_get(count), np.float64).sum()
+                )
+        if self._live_id is not None:
+            out[(0, self._live_id)] = float(
+                np.asarray(
+                    jax.device_get(self._live.count), np.float64
+                ).sum()
+            )
+        return out
+
+    def __repr__(self) -> str:
+        return (
+            f"WindowedSketch(n_streams={self._n_streams},"
+            f" backend={self.spec.backend!r},"
+            f" rungs={[f'{s:g}s x {n}' for s, n in zip(self.config.slices_s, self.config.lengths)]},"
+            f" live_buckets={len(self.buckets())},"
+            f" total_mass={self._total:g})"
+        )
+
+
+#: Oracle-side jitted quantile per spec (the decode any facade query
+#: would run; cached so repeated oracle audits do not recompile).
+_ORACLE_Q_CACHE: Dict[SketchSpec, Callable] = {}
+
+
+def oracle_quantile(
+    wsk: WindowedSketch,
+    quantiles: Sequence[float],
+    window: Optional[float] = None,
+):
+    """The host-driven oracle: sequentially merge the covered buckets
+    with the backend's own merge (one eager dispatch per pair) and
+    answer the fused quantile -> ``[n_streams, Q]``.
+
+    The windowed query must be bit-identical to this -- the exactness
+    contract ``tests/test_windows.py`` and the chaos campaign pin.
+    Empty coverage answers NaN like the query itself; never mutates
+    the ring beyond the same rotation the query would perform.
+    """
+    plan = wsk.window_plan(window)
+    qs = tuple(float(q) for q in quantiles)
+    if not plan.states:
+        return np.full(
+            (wsk.n_streams, len(qs)), np.nan,
+            np.dtype(jnp.dtype(wsk.spec.dtype).name),
+        )
+    spec = wsk.spec
+    if _fold_mode(spec, plan.states) == "aligned":
+        # The identical host-side mode choice the fused fold makes:
+        # aligned dense windows merge elementwise (no recenter rolls).
+        acc = functools.reduce(
+            functools.partial(batched.merge, spec), plan.states
+        )
+    else:
+        acc = functools.reduce(wsk._merge_states, plan.states)
+    if spec.backend == "moment":
+        from sketches_tpu.backends import moment
+
+        return moment.quantile(spec, acc, qs)
+    # The merged state decodes through the standard JITTED quantile --
+    # exactly what any facade query runs (the eager merge chain is
+    # bit-identical to the fused fold's; quantile is always a jitted
+    # dispatch in this library, so the oracle holds it to that).
+    qfn = _ORACLE_Q_CACHE.get(spec)
+    if qfn is None:
+        if spec.backend == "uniform_collapse":
+            from sketches_tpu.backends import uniform
+
+            qfn = jax.jit(functools.partial(uniform.quantile, spec))
+        else:
+            qfn = jax.jit(functools.partial(batched.quantile, spec))
+        _ORACLE_Q_CACHE[spec] = qfn
+    return qfn(acc, jnp.asarray(qs, spec.dtype))

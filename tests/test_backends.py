@@ -115,7 +115,8 @@ class TestSpec:
         # are wire contract -- changing one silently misdecodes old
         # blobs.
         assert BACKEND_ENUM == {
-            "dense": 0, "uniform_collapse": 1, "moment": 2
+            "dense": 0, "uniform_collapse": 1, "moment": 2,
+            "windowed": 3,
         }
 
     def test_adaptive_kill_switch_declared(self):

@@ -21,6 +21,7 @@ _EXAMPLES = os.path.join(
         "chaos_drill.py",
         "fleet_dashboard.py",
         "serve_load.py",
+        "windowed_dashboard.py",
     ],
 )
 def test_example_runs_clean(script):

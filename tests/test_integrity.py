@@ -708,6 +708,45 @@ def _detect_serve_cache_poison():
     )
 
 
+def _detect_window_rotate_torn():
+    """A torn windowed-ring rotation raises at the seam and leaves the
+    ring, the exact mass ledger, and the live bucket bit-identical --
+    rotation is atomic; the interrupted rotation then completes cleanly
+    on the next write."""
+    from sketches_tpu.windows import (
+        VirtualClock,
+        WindowConfig,
+        WindowedSketch,
+    )
+
+    clk = VirtualClock(0.0)
+    w = WindowedSketch(
+        8, spec=SPEC,
+        config=WindowConfig(slices_s=(5.0,), lengths=(2,)), clock=clk,
+    )
+    w.add(np.full((8, 16), 1.5, np.float32))
+    before_led, before_buckets = w.ledger(), w.buckets()
+    clk.advance(7.0)  # rotation now due
+    faults.arm(faults.WINDOW_ROTATE_TORN, times=1)
+    try:
+        try:
+            w.add(np.full((8, 16), 2.5, np.float32))
+            return False  # the tear did not surface
+        except resilience.InjectedFault:
+            pass
+    finally:
+        faults.disarm()
+    if w.ledger() != before_led or w.buckets() != before_buckets:
+        return False  # the tear mutated the ring
+    w.add(np.full((8, 16), 2.5, np.float32))
+    led = w.ledger()
+    return (
+        led["total"] == 256.0
+        and led["total"] == led["live"] + led["retired"]
+        and not integrity.check_window(w)
+    )
+
+
 #: Every injectable site maps to a detector proof -- the closure the
 #: satellite task demands: no silently undetectable fault site.
 _SITE_DETECTORS = {
@@ -725,6 +764,7 @@ _SITE_DETECTORS = {
     faults.SERVE_STRAGGLER: _detect_serve_straggler,
     faults.SERVE_QUEUE_OVERFLOW: _detect_serve_queue_overflow,
     faults.SERVE_CACHE_POISON: _detect_serve_cache_poison,
+    faults.WINDOW_ROTATE_TORN: _detect_window_rotate_torn,
 }
 
 

@@ -1,0 +1,685 @@
+"""Time-windowed quantiles: ring/ladder rotation, the exact mass
+ledger, window-query == oracle-merge bit-identity across every backend,
+and the serve/checkpoint/wire/chaos seams (ISSUE 13).
+
+Kill-switch parity: with ``SKETCHES_TPU_WINDOWED=0`` (the CI
+loud-refusal lane) every functional test skips and the refusal tests
+assert the constructor raises ``SpecError`` -- the suite passes in both
+modes.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from sketches_tpu import checkpoint, faults, integrity, serve, telemetry
+from sketches_tpu.analysis import registry
+from sketches_tpu.backends.wirefmt import (
+    payload_from_bytes,
+    windowed_from_bytes,
+    windowed_to_bytes,
+)
+from sketches_tpu.batched import SketchSpec
+from sketches_tpu.resilience import (
+    CheckpointCorrupt,
+    InjectedFault,
+    SketchValueError,
+    SpecError,
+    UnequalSketchParametersError,
+    WireDecodeError,
+)
+from sketches_tpu.windows import (
+    DEFAULT_LADDER,
+    VirtualClock,
+    WindowConfig,
+    WindowedSketch,
+    oracle_quantile,
+)
+
+_ARMED = registry.enabled(registry.WINDOWED)
+needs_windowed = pytest.mark.skipif(
+    not _ARMED, reason="SKETCHES_TPU_WINDOWED=0 (loud-refusal lane)"
+)
+
+DENSE = SketchSpec(relative_accuracy=0.02, n_bins=128)
+ADAPTIVE = SketchSpec(
+    relative_accuracy=0.02, n_bins=128, backend="uniform_collapse"
+)
+MOMENT = SketchSpec(relative_accuracy=0.02, backend="moment", n_moments=8)
+CFG = WindowConfig(slices_s=(5.0, 20.0), lengths=(3, 3))
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.disarm()
+    integrity.disarm()
+    integrity.reset()
+    yield
+    faults.disarm()
+    integrity.disarm()
+    integrity.reset()
+
+
+def _ring(spec=DENSE, config=CFG, t0=0.0, n=N, **kw):
+    clk = VirtualClock(t0)
+    return WindowedSketch(n, spec=spec, config=config, clock=clk, **kw), clk
+
+
+def _drive(wsk, clk, rng, steps, dt=(1.0, 5.0), batch=16):
+    for _ in range(steps):
+        clk.advance(float(rng.uniform(*dt)))
+        wsk.add(rng.lognormal(0.0, 0.7, (wsk.n_streams, batch)).astype(
+            np.float32
+        ))
+
+
+# ---------------------------------------------------------------------------
+# Config validation + kill switch (both arming modes)
+# ---------------------------------------------------------------------------
+
+
+class TestConfigAndKillSwitch:
+    def test_kill_switch_refuses_loudly(self, monkeypatch):
+        """In BOTH arming modes a disarmed construction raises
+        SpecError naming the switch's intent -- never a silent
+        unwindowed fallback."""
+        monkeypatch.setenv(registry.WINDOWED.name, "0")
+        with pytest.raises(SpecError, match="SKETCHES_TPU_WINDOWED"):
+            WindowedSketch(2, spec=DENSE, clock=VirtualClock())
+        srv = serve.SketchServer(clock=VirtualClock())
+        with pytest.raises(SpecError):
+            srv.add_tenant("w", 2, window=True, spec=DENSE)
+
+    @needs_windowed
+    def test_armed_by_default_constructs(self):
+        w, _ = _ring()
+        assert w.config == CFG and w.total_mass == 0.0
+
+    def test_registry_declared(self):
+        v = registry.lookup("SKETCHES_TPU_WINDOWED")
+        assert v.default == "1" and v.owner == "sketches_tpu.windows"
+
+    def test_metrics_declared(self):
+        for name, kind in (
+            ("window.rotations", "counter"),
+            ("window.retired_mass", "counter"),
+            ("window.ladder_collapses", "counter"),
+            ("window.covered_buckets", "gauge"),
+        ):
+            assert telemetry.METRICS[name].kind == kind
+
+    @pytest.mark.parametrize(
+        "kwargs",
+        [
+            dict(slices_s=(), lengths=()),
+            dict(slices_s=(5.0,), lengths=(3, 3)),
+            dict(slices_s=(5.0, 0.0), lengths=(3, 3)),
+            dict(slices_s=(5.0, 60.0), lengths=(3, 0)),
+            dict(slices_s=(5.0, 12.0), lengths=(3, 3)),  # not a multiple
+            dict(slices_s=(60.0, 5.0), lengths=(3, 3)),  # not coarsening
+            dict(slices_s=(5.0, 60.0), lengths=(3, 3),
+                 collapse_levels=(1,)),  # wrong arity
+            dict(slices_s=(5.0, 60.0), lengths=(3, 3),
+                 collapse_levels=(2, 1)),  # decreasing
+        ],
+    )
+    def test_bad_configs_refuse(self, kwargs):
+        with pytest.raises(SpecError):
+            WindowConfig(**kwargs)
+
+    @needs_windowed
+    def test_collapse_levels_need_adaptive_backend(self):
+        cfg = WindowConfig(
+            slices_s=(5.0, 20.0), lengths=(2, 2), collapse_levels=(0, 2)
+        )
+        with pytest.raises(SpecError, match="uniform_collapse"):
+            WindowedSketch(
+                2, spec=DENSE, config=cfg, clock=VirtualClock()
+            )
+
+    def test_default_ladder_shape(self):
+        assert DEFAULT_LADDER.slices_s == (5.0, 60.0, 3600.0)
+        assert DEFAULT_LADDER.horizon_s() == 12 * 5 + 60 * 60 + 24 * 3600
+
+    def test_virtual_clock_monotone(self):
+        clk = VirtualClock(3.0)
+        assert clk() == 3.0 and clk.advance(2.0) == 5.0
+        with pytest.raises(SketchValueError):
+            clk.advance(-1.0)
+
+
+# ---------------------------------------------------------------------------
+# Rotation + the exact mass ledger
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestLedger:
+    def test_ledger_exact_through_rotations(self):
+        w, clk = _ring()
+        rng = np.random.default_rng(0)
+        _drive(w, clk, rng, 30)
+        led = w.ledger()
+        assert led["total"] == 30 * N * 16
+        assert led["total"] == led["live"] + led["retired"]
+        assert led["rotations"] > 0
+        device = w.device_masses()
+        for rung, bid, mass in w.buckets():
+            assert device[(rung, bid)] == mass
+
+    def test_everything_retires_after_horizon(self):
+        w, clk = _ring()
+        rng = np.random.default_rng(1)
+        _drive(w, clk, rng, 6)
+        total = w.total_mass
+        clk.advance(10_000.0)
+        w.add(np.ones((N, 4), np.float32))  # triggers the roll
+        led = w.ledger()
+        assert led["retired"] == total
+        assert led["total"] == led["live"] + led["retired"]
+        assert led["live"] == N * 4  # only the fresh batch survives
+        # The whole horizon now answers from the fresh unit batch alone
+        # (the retired history contributes nothing).
+        vals = np.asarray(w.quantile([0.5], window=None))
+        assert np.allclose(vals, 1.0, rtol=0.03)
+
+    def test_weighted_and_padded_mass(self):
+        w, clk = _ring()
+        clk.advance(1.0)
+        vals = np.ones((N, 8), np.float32)
+        weights = np.ones((N, 8), np.float32)
+        weights[:, ::2] = 0.0  # padding lanes (w <= 0) carry no mass
+        w.add(vals, weights)
+        assert w.total_mass == N * 4
+        device = w.device_masses()
+        (key,) = device
+        assert device[key] == w.total_mass
+
+    def test_check_window_catches_forged_ledger(self):
+        w, clk = _ring()
+        clk.advance(1.0)
+        w.add(np.ones((N, 8), np.float32))
+        assert not integrity.check_window(w)
+        w._total += 1.0  # forge the ledger
+        report = integrity.check_window(w)
+        assert report and "window_ledger" in report.counters
+
+    def test_merge_rings(self):
+        a, clk_a = _ring()
+        b, clk_b = _ring()
+        rng = np.random.default_rng(2)
+        for _ in range(8):
+            clk_a.advance(3.0)
+            clk_b.advance(3.0)
+            a.add(rng.lognormal(0, 0.5, (N, 8)).astype(np.float32))
+            b.add(rng.lognormal(0, 0.5, (N, 8)).astype(np.float32))
+        total = a.total_mass + b.total_mass
+        a.merge(b)
+        led = a.ledger()
+        assert led["total"] == total
+        assert led["total"] == led["live"] + led["retired"]
+        device = a.device_masses()
+        for rung, bid, mass in a.buckets():
+            assert device[(rung, bid)] == mass
+
+    def test_merge_mismatch_refuses(self):
+        a, _ = _ring()
+        b, _ = _ring(config=WindowConfig(slices_s=(5.0,), lengths=(4,)))
+        with pytest.raises(UnequalSketchParametersError):
+            a.merge(b)
+
+
+# ---------------------------------------------------------------------------
+# Window-query exactness: bit-identical to the oracle merge
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestOracleExactness:
+    @pytest.mark.parametrize(
+        "spec,cfg",
+        [
+            (DENSE, CFG),
+            pytest.param(
+                ADAPTIVE,
+                WindowConfig(
+                    slices_s=(5.0, 20.0), lengths=(2, 2),
+                    collapse_levels=(0, 2),
+                ),
+                # The adaptive fold chain unrolls the collapse ladder
+                # per merge: compile-heavy, so this lane rides the slow
+                # mark (the windowed-soak CI job runs it; tier-1 keeps
+                # the dense/moment lanes).
+                marks=pytest.mark.slow,
+            ),
+            (MOMENT, WindowConfig(slices_s=(5.0, 20.0), lengths=(2, 2))),
+        ],
+        ids=["dense", "uniform_collapse", "moment"],
+    )
+    def test_bit_identical_to_oracle(self, spec, cfg):
+        """quantile(window=W) == oracle host-side merge of the covered
+        buckets, across partial leading/trailing windows, the full
+        horizon, empty windows, and post-rotation states."""
+        w, clk = _ring(spec=spec, config=cfg, n=4)
+        rng = np.random.default_rng(5)
+        # Adaptive fold chains compile per covered arity (the uniform
+        # merge unrolls its collapse ladder), so the checkpoints below
+        # are chosen to exercise partial leading/trailing windows and
+        # the full horizon while keeping the arity set small.
+        wins = (3.0, 17.0, None) if spec.backend == "dense" else (17.0, None)
+        checks = (3, 7, 13) if spec.backend == "dense" else (6, 13)
+        for step in range(14):
+            clk.advance(float(rng.uniform(1.0, 6.0)))
+            w.add(rng.lognormal(0, 0.7, (4, 8)).astype(np.float32))
+            if step not in checks:
+                continue
+            for win in wins:
+                got = np.asarray(w.quantile([0.25, 0.5, 0.99], window=win))
+                want = np.asarray(
+                    oracle_quantile(w, [0.25, 0.5, 0.99], window=win)
+                )
+                assert np.array_equal(got, want, equal_nan=True), (
+                    step, win, got - want,
+                )
+
+    def test_empty_window_answers_nan(self):
+        w, clk = _ring()
+        clk.advance(1.0)
+        w.add(np.ones((N, 4), np.float32))
+        clk.advance(500.0)
+        vals = np.asarray(w.quantile([0.5, 0.9], window=2.0))
+        assert vals.shape == (N, 2) and np.isnan(vals).all()
+
+    def test_fresh_ring_answers_nan(self):
+        w, _ = _ring()
+        assert np.isnan(np.asarray(w.quantile([0.5]))).all()
+
+    def test_current_bucket_at_slice_boundary_is_covered(self):
+        w, clk = _ring(t0=100.0)  # now sits exactly on a 5 s boundary
+        w.add(np.full((N, 4), 2.0, np.float32))
+        vals = np.asarray(w.quantile([0.5], window=10.0))
+        assert np.isfinite(vals).all()
+
+    def test_facade_parity_alias(self):
+        w, clk = _ring()
+        clk.advance(1.0)
+        w.add(np.full((N, 4), 3.0, np.float32))
+        assert np.array_equal(
+            np.asarray(w.get_quantile_values([0.5, 0.9])),
+            np.asarray(w.quantile([0.5, 0.9], window=None)),
+            equal_nan=True,
+        )
+
+    def test_post_reshard_bit_identity(self):
+        """Buckets survive reshard: frozen states are topology-free,
+        and the post-reshard window answer still equals the oracle."""
+        from sketches_tpu.parallel import SketchMesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        clk = VirtualClock(0.0)
+        w = WindowedSketch(
+            N, spec=DENSE, config=CFG, clock=clk, mesh=SketchMesh(2)
+        )
+        rng = np.random.default_rng(6)
+        _drive(w, clk, rng, 8, batch=8)
+        before = np.asarray(w.quantile([0.5, 0.99], window=25.0))
+        report = w.reshard(n_devices=1)
+        assert report.n_dead == 0
+        after = np.asarray(w.quantile([0.5, 0.99], window=25.0))
+        assert np.array_equal(before, after, equal_nan=True)
+        want = np.asarray(oracle_quantile(w, [0.5, 0.99], window=25.0))
+        assert np.array_equal(after, want, equal_nan=True)
+        led = w.ledger()
+        assert led["total"] == led["live"] + led["retired"]
+
+
+# ---------------------------------------------------------------------------
+# Ladder coarsening: collapse-on-retire + the declared alpha contract
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestLadder:
+    def test_collapse_on_retire_and_effective_alpha(self):
+        cfg = WindowConfig(
+            slices_s=(5.0, 20.0), lengths=(2, 2), collapse_levels=(0, 2)
+        )
+        w, clk = _ring(spec=ADAPTIVE, config=cfg, n=4)
+        rng = np.random.default_rng(7)
+        _drive(w, clk, rng, 14, batch=8)
+        led = w.ledger()
+        assert led["ladder_collapses"] > 0
+        assert led["total"] == led["live"] + led["retired"]
+        alphas = w.rung_effective_alpha()
+        assert len(alphas) == 2
+        assert alphas[0] == pytest.approx(0.02, rel=1e-3)
+        assert alphas[1] > alphas[0]  # the coarser rung degraded alpha
+        # Rung-1 buckets sit at (at least) the declared level.
+        for bid, b in w._rungs[1].items():
+            assert int(np.asarray(b.state.level).min()) >= 2
+
+    def test_dense_ladder_keeps_spec_alpha(self):
+        w, _ = _ring()
+        assert w.rung_effective_alpha() == [0.02, 0.02]
+
+    def test_rotation_telemetry_counters(self):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            w, clk = _ring(
+                config=WindowConfig(slices_s=(5.0,), lengths=(2,))
+            )
+            rng = np.random.default_rng(8)
+            _drive(w, clk, rng, 10, dt=(4.0, 7.0), batch=4)
+            w.quantile([0.5], window=8.0)
+            snap = telemetry.snapshot()
+            counters = snap["counters"]
+            assert counters.get("window.rotations", 0) > 0
+            assert counters.get("window.retired_mass", 0) > 0
+            assert snap["gauges"].get("window.covered_buckets", 0) >= 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# Rotation atomicity (the window.rotate_torn site)
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestRotationAtomicity:
+    def test_torn_rotation_mutates_nothing(self):
+        w, clk = _ring()
+        rng = np.random.default_rng(9)
+        _drive(w, clk, rng, 5)
+        before_led, before_buckets = w.ledger(), w.buckets()
+        before_q = np.asarray(w.quantile([0.5], window=None))
+        clk.advance(12.0)
+        faults.arm(faults.WINDOW_ROTATE_TORN, times=1)
+        try:
+            with pytest.raises(InjectedFault):
+                w.add(np.ones((N, 4), np.float32))
+        finally:
+            faults.disarm()
+        assert w.ledger() == before_led
+        assert w.buckets() == before_buckets
+        assert np.array_equal(
+            np.asarray(w.quantile([0.5], window=None)), before_q,
+            equal_nan=True,
+        )
+        # The interrupted rotation completes cleanly afterwards.
+        w.add(np.ones((N, 4), np.float32))
+        led = w.ledger()
+        assert led["total"] == before_led["total"] + N * 4
+        assert led["total"] == led["live"] + led["retired"]
+
+    def test_site_is_declared(self):
+        assert faults.WINDOW_ROTATE_TORN in faults.SITES
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint: ring + ladder + ledger, atomically
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestCheckpoint:
+    @pytest.mark.parametrize(
+        "spec,cfg",
+        [
+            (DENSE, CFG),
+            pytest.param(
+                ADAPTIVE,
+                WindowConfig(
+                    slices_s=(5.0, 20.0), lengths=(2, 2),
+                    collapse_levels=(0, 1),
+                ),
+                # Compile-heavy adaptive fold (see the oracle suite):
+                # slow lane; the windowed-soak CI job runs it.
+                marks=pytest.mark.slow,
+            ),
+            (MOMENT, WindowConfig(slices_s=(5.0, 20.0), lengths=(2, 2))),
+        ],
+        ids=["dense", "uniform_collapse", "moment"],
+    )
+    def test_roundtrip_all_backends(self, tmp_path, spec, cfg):
+        w, clk = _ring(spec=spec, config=cfg, n=4)
+        rng = np.random.default_rng(10)
+        _drive(w, clk, rng, 8, batch=8)
+        path = str(tmp_path / f"{spec.backend}.ckpt")
+        checkpoint.save_windowed(path, w)
+        restored = checkpoint.restore_windowed(
+            path, clock=VirtualClock(clk.t)
+        )
+        assert restored.ledger() == w.ledger()
+        assert restored.buckets() == w.buckets()
+        got = np.asarray(restored.quantile([0.5, 0.9], window=30.0))
+        want = np.asarray(w.quantile([0.5, 0.9], window=30.0))
+        assert np.array_equal(got, want, equal_nan=True)
+
+    def test_armed_fingerprints_roundtrip(self, tmp_path):
+        integrity.arm("raise")
+        w, clk = _ring(n=4)
+        rng = np.random.default_rng(11)
+        _drive(w, clk, rng, 5, batch=8)
+        path = str(tmp_path / "armed.ckpt")
+        checkpoint.save_windowed(path, w)
+        restored = checkpoint.restore_windowed(
+            path, clock=VirtualClock(clk.t)
+        )
+        assert restored.ledger() == w.ledger()
+
+    def test_torn_write_refuses_previous_survives(self, tmp_path):
+        w, clk = _ring(n=4)
+        clk.advance(1.0)
+        w.add(np.ones((4, 8), np.float32))
+        path = str(tmp_path / "torn.ckpt")
+        checkpoint.save_windowed(path, w)
+        with faults.active(
+            {faults.CHECKPOINT_WRITE: dict(mode="raise", times=1)}
+        ):
+            with pytest.raises(InjectedFault):
+                checkpoint.save_windowed(path, w)
+        restored = checkpoint.restore_windowed(
+            path, clock=VirtualClock(clk.t)
+        )  # the previous good file
+        assert restored.total_mass == w.total_mass
+        with faults.active(
+            {faults.CHECKPOINT_WRITE: dict(mode="truncate", times=1)}
+        ):
+            checkpoint.save_windowed(path, w)
+        with pytest.raises(CheckpointCorrupt):
+            checkpoint.restore_windowed(path, clock=VirtualClock(clk.t))
+
+    def test_batched_checkpoint_is_not_windowed(self, tmp_path):
+        from sketches_tpu.batched import BatchedDDSketch
+
+        sk = BatchedDDSketch(4, spec=DENSE)
+        sk.add(np.ones((4, 8), np.float32))
+        path = str(tmp_path / "plain.ckpt")
+        checkpoint.save(path, sk)
+        with pytest.raises(CheckpointCorrupt, match="not a windowed"):
+            checkpoint.restore_windowed(path)
+        with pytest.raises(SpecError):
+            checkpoint.save_windowed(str(tmp_path / "x.ckpt"), sk)
+
+
+# ---------------------------------------------------------------------------
+# Wire envelope
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestWire:
+    def test_roundtrip_and_bit_identity(self):
+        w, clk = _ring(n=4)
+        rng = np.random.default_rng(12)
+        _drive(w, clk, rng, 8, batch=8)
+        blob = windowed_to_bytes(w)
+        assert blob[:1] == b"\x08"  # envelope tag: old readers dispatch
+        restored = windowed_from_bytes(
+            DENSE, blob, clock=VirtualClock(clk.t)
+        )
+        assert restored.ledger() == w.ledger()
+        assert restored.buckets() == w.buckets()
+        got = np.asarray(restored.quantile([0.5, 0.99], window=25.0))
+        want = np.asarray(w.quantile([0.5, 0.99], window=25.0))
+        assert np.array_equal(got, want, equal_nan=True)
+
+    def test_old_reader_refuses_loudly(self):
+        """A windowed blob under a plain backend spec refuses BY NAME
+        (the append-only enum contract)."""
+        w, clk = _ring(n=2)
+        clk.advance(1.0)
+        w.add(np.ones((2, 4), np.float32))
+        blob = windowed_to_bytes(w)
+        with pytest.raises(WireDecodeError, match="windowed|envelope"):
+            payload_from_bytes(DENSE, [blob])
+        with pytest.raises(WireDecodeError, match="windowed"):
+            payload_from_bytes(MOMENT, [blob])
+
+    def test_plain_blob_refused_by_windowed_reader(self):
+        from sketches_tpu.backends.wirefmt import payload_to_bytes
+        from sketches_tpu.batched import BatchedDDSketch
+
+        sk = BatchedDDSketch(2, spec=DENSE)
+        sk.add(np.ones((2, 4), np.float32))
+        blob = payload_to_bytes(DENSE, sk.state)[0]
+        with pytest.raises(WireDecodeError):
+            windowed_from_bytes(DENSE, blob)
+
+    def test_config_mismatch_refuses(self):
+        w, clk = _ring(n=2)
+        clk.advance(1.0)
+        w.add(np.ones((2, 4), np.float32))
+        blob = windowed_to_bytes(w)
+        other = WindowConfig(slices_s=(5.0,), lengths=(4,))
+        with pytest.raises(WireDecodeError, match="ladder"):
+            windowed_from_bytes(DENSE, blob, config=other)
+
+    def test_truncated_blob_refuses(self):
+        w, clk = _ring(n=2)
+        clk.advance(1.0)
+        w.add(np.ones((2, 4), np.float32))
+        blob = windowed_to_bytes(w)
+        with pytest.raises(WireDecodeError):
+            windowed_from_bytes(DENSE, blob[: len(blob) // 2])
+
+
+# ---------------------------------------------------------------------------
+# Serving: quantile(tenant, q, window=...) with fingerprint-set cache keys
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestServe:
+    def _server(self, t0=100.0):
+        clk = VirtualClock(t0)
+        srv = serve.SketchServer(clock=clk)
+        srv.add_tenant("w", 4, window=CFG, spec=DENSE)
+        rng = np.random.default_rng(13)
+        srv.ingest("w", rng.lognormal(0, 0.5, (4, 16)).astype(np.float32))
+        return srv, clk, rng
+
+    def test_hit_then_ingest_misses(self):
+        srv, clk, rng = self._server()
+        r1 = srv.quantile("w", [0.5, 0.99], window=15.0)
+        assert r1.tier == "window"
+        r2 = srv.quantile("w", [0.5, 0.99], window=15.0)
+        assert r2.cached and np.array_equal(
+            r1.values, r2.values, equal_nan=True
+        )
+        srv.ingest("w", rng.lognormal(0, 0.5, (4, 16)).astype(np.float32))
+        r3 = srv.quantile("w", [0.5, 0.99], window=15.0)
+        assert r3.tier == "window"  # fingerprint set moved -> miss
+
+    def test_rotation_can_never_serve_stale_wrong(self):
+        """The poison-free-under-rotation acceptance: after rotations
+        and new ingest the served window answer always equals the
+        ring's direct answer (cached entries keyed on the covered
+        fingerprint set either hit bit-correct or miss)."""
+        srv, clk, rng = self._server()
+        for step in range(10):
+            srv.quantile("w", [0.5, 0.99], window=15.0)
+            clk.advance(float(rng.uniform(1.0, 7.0)))
+            srv.ingest(
+                "w", rng.lognormal(0, 0.5, (4, 16)).astype(np.float32)
+            )
+            res = srv.quantile("w", [0.5, 0.99], window=15.0)
+            direct = np.asarray(
+                srv.tenant("w").quantile([0.5, 0.99], window=15.0)
+            )
+            assert np.array_equal(res.values, direct, equal_nan=True), step
+
+    def test_rotation_without_content_change_hits_correctly(self):
+        srv, clk, rng = self._server()
+        r1 = srv.quantile("w", [0.5], window=15.0)
+        clk.advance(6.0)  # rotation: same covered content, new ring shape
+        r2 = srv.quantile("w", [0.5], window=15.0)
+        direct = np.asarray(srv.tenant("w").quantile([0.5], window=15.0))
+        assert np.array_equal(r2.values, direct, equal_nan=True)
+        assert np.array_equal(r1.values, r2.values, equal_nan=True)
+
+    def test_cache_poison_recomputes(self):
+        srv, clk, rng = self._server()
+        srv.quantile("w", [0.9], window=15.0)
+        direct = np.asarray(srv.tenant("w").quantile([0.9], window=15.0))
+        faults.arm(faults.SERVE_CACHE_POISON, times=1)
+        try:
+            res = srv.quantile("w", [0.9], window=15.0)
+        finally:
+            faults.disarm()
+        assert not res.cached
+        assert np.array_equal(res.values, direct, equal_nan=True)
+        assert srv.stats()["cache_poisoned"] == 1
+
+    def test_submit_path_refuses_windowed_tenant(self):
+        srv, clk, rng = self._server()
+        with pytest.raises(SpecError, match="window"):
+            srv.query("w", [0.5])
+
+    def test_plain_tenant_window_query_refuses(self):
+        srv, clk, rng = self._server()
+        srv.add_tenant("p", 4, spec=DENSE)
+        with pytest.raises(SpecError, match="not time-windowed"):
+            srv.quantile("p", [0.5], window=5.0)
+        srv.ingest("p", rng.lognormal(0, 0.5, (4, 16)).astype(np.float32))
+        res = srv.quantile("p", [0.5])  # passthrough to query()
+        assert res.values.shape == (4, 1) and res.tier != "window"
+
+    def test_spent_deadline_refuses(self):
+        srv, clk, rng = self._server()
+        from sketches_tpu.resilience import DeadlineExceeded
+
+        with pytest.raises(DeadlineExceeded):
+            srv.quantile("w", [0.5], window=15.0, deadline_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Chaos campaign (short deterministic drill; CI soaks 400 steps)
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestChaos:
+    @pytest.mark.slow
+    def test_windowed_campaign_clean_and_deterministic(self):
+        from sketches_tpu import chaos
+
+        verdict = chaos.run_windowed_campaign(40, seed=13)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["outcomes"].get("undetected", 0) == 0
+        again = chaos.run_windowed_campaign(40, seed=13)
+        assert again["events"] == verdict["events"]
+
+    def test_campaign_rejects_bad_steps(self):
+        from sketches_tpu import chaos
+
+        with pytest.raises(SketchValueError):
+            chaos.run_windowed_campaign(0, seed=1)
