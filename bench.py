@@ -1496,10 +1496,18 @@ def bench_windowed(skip_1m: bool = False):
       crosses a slice boundary (freeze + ladder cascade) over a plain
       same-bucket ``add`` (medians of interleaved reps);
     * ``window_query_p50_s`` -- the ONE fused stacked-merge dispatch
-      over the covered buckets (arity reported), vs
-      ``single_sketch_query_p50_s`` -- the same quantiles on one plain
-      ``BatchedDDSketch`` holding the same total mass (the price of
-      windowing is exactly the stacked merge).
+      over the maintained two-stacks components (fold arity reported),
+      vs ``single_sketch_query_p50_s`` -- the same quantiles on one
+      plain ``BatchedDDSketch`` holding the same total mass (the price
+      of windowing is exactly the stacked merge);
+    * ``window_query_vs_single_floorsub`` -- the same ratio with the
+      measured dispatch floor subtracted from both sides (the
+      acceptance letter: <= 1.5x with the maintained aggregates on);
+    * ``window_query_p50_aggoff_s`` -- a second ring replays the exact
+      ingest schedule under ``SKETCHES_TPU_WINDOW_AGG=0`` so the
+      off/on pair times the SAME covered set through the full re-merge
+      (the pre-aggregation path); ``agg`` carries the maintained-layer
+      scoreboard (``agg_stats``).
     """
     import jax
     import jax.numpy as jnp
@@ -1558,6 +1566,48 @@ def bench_windowed(skip_1m: bool = False):
         jax.block_until_ready(baseline.get_quantile_values(qs))
         reps.append(time.perf_counter() - t0)
     base_p50 = sorted(reps)[len(reps) // 2]
+    # -- floor-subtracted ratio (the acceptance letter's number): both
+    # sides pay one dispatch, so subtracting the measured floor leaves
+    # the pure fold-arity cost difference --
+    floor = dispatch_floor_s()
+    window_floorsub = max(window_p50 - floor, 0.0)
+    base_floorsub = max(base_p50 - floor, 1e-9)
+    fold_arity = (
+        len(plan.components) if plan.components is not None
+        else plan.n_covered
+    )
+    # -- the pre-aggregation path: a fresh ring replays the exact same
+    # ingest schedule under SKETCHES_TPU_WINDOW_AGG=0, so the off/on
+    # pair times the SAME covered set through the full re-merge --
+    from sketches_tpu.analysis import registry as _registry
+
+    switch = _registry.WINDOW_AGG.name
+    prior = os.environ.get(switch)
+    os.environ[switch] = "0"
+    try:
+        off_clock = VirtualClock(0.0)
+        off = WindowedSketch(n, spec=spec, config=cfg, clock=off_clock)
+    finally:
+        if prior is None:
+            os.environ.pop(switch, None)
+        else:
+            os.environ[switch] = prior
+    for _ in range(10):
+        off_clock.advance(5.0)
+        off.add(vals)
+    for _ in range(8):
+        off_clock.advance(0.5)
+        off.add(vals)
+        off_clock.advance(5.0)
+        off.add(vals)
+    off_plan = off.window_plan(None)
+    jax.block_until_ready(off.query_plan(off_plan, qs))  # compile
+    reps = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        jax.block_until_ready(off.query_plan(off_plan, qs))
+        reps.append(time.perf_counter() - t0)
+    off_p50 = sorted(reps)[len(reps) // 2]
     led = wsk.ledger()
     return {
         "n_streams": n,
@@ -1574,6 +1624,15 @@ def bench_windowed(skip_1m: bool = False):
         "window_query_vs_single": round(
             window_p50 / max(base_p50, 1e-9), 2
         ),
+        "window_query_p50_floorsub_s": round(window_floorsub, 6),
+        "single_query_p50_floorsub_s": round(base_floorsub, 6),
+        "window_query_vs_single_floorsub": round(
+            window_floorsub / base_floorsub, 2
+        ),
+        "fold_arity": fold_arity,
+        "window_query_p50_aggoff_s": round(off_p50, 6),
+        "aggoff_vs_aggon": round(off_p50 / max(window_p50, 1e-9), 2),
+        "agg": wsk.agg_stats(),
         "ledger_exact": led["total"] == led["live"] + led["retired"],
     }
 

@@ -68,7 +68,7 @@ from sketches_tpu import backends
 from sketches_tpu import windows
 from sketches_tpu.windows import WindowConfig, WindowedSketch
 
-__version__ = "0.16.0"
+__version__ = "0.17.0"
 
 __all__ = [
     "BaseDDSketch",

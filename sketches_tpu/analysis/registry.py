@@ -41,6 +41,7 @@ __all__ = [
     "INGEST_PACKED",
     "ADAPTIVE",
     "WINDOWED",
+    "WINDOW_AGG",
     "REGISTRY",
     "declared",
     "get",
@@ -239,6 +240,20 @@ WINDOWED = EnvVar(
     ),
 )
 
+#: Incremental window-aggregation kill switch (``sketches_tpu.windows``).
+WINDOW_AGG = EnvVar(
+    name="SKETCHES_TPU_WINDOW_AGG",
+    default="1",
+    owner="sketches_tpu.windows",
+    doc=(
+        "Set to 0 to disable the maintained two-stacks window"
+        " aggregates: every window query falls back to the full"
+        " re-merge over the covered buckets (the pre-aggregation"
+        " path); answers stay correct, only the per-query merge count"
+        " grows back to O(covered buckets)."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
@@ -247,7 +262,7 @@ REGISTRY: Dict[str, EnvVar] = {
     for v in (
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
         ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
-        FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE, WINDOWED,
+        FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE, WINDOWED, WINDOW_AGG,
     )
 }
 

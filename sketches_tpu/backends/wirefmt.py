@@ -779,4 +779,8 @@ def windowed_from_bytes(spec, blob: bytes, *, config=None, clock=None,
         wsk._rotations = int(ledger[2])
         wsk._ladder_collapses = int(ledger[3])
     wsk._cur = None if cur_plus1 == 0 else int(cur_plus1 - 1)
+    # The rungs were assigned behind the constructor's back; the wire
+    # format never carries the two-stacks aggregates (derived state),
+    # so drop the fresh stacks and let the first plan rebuild them.
+    wsk._agg_invalidate()
     return wsk

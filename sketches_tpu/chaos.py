@@ -1526,8 +1526,11 @@ _WD_WINDOWS = (7.0, 30.0, None)
 def _wd_audit_ring(name: str, wsk, expected_total: float) -> None:
     """The exact mass-ledger audit (== everywhere, the acceptance
     contract): total == live + retired, every bucket's ledger entry ==
-    its device mass, and the ring's total == the campaign's expectation.
-    Raises ``SketchError`` on any breach."""
+    its device mass, the ring's total == the campaign's expectation,
+    and every CACHED maintained aggregate matches its raw-state fold
+    bit-for-bit (the two-stacks consistency audit -- a no-op when the
+    ``SKETCHES_TPU_WINDOW_AGG`` layer is off or the stacks are
+    dropped).  Raises ``SketchError`` on any breach."""
     led = wsk.ledger()
     if led["total"] != led["live"] + led["retired"]:
         raise SketchError(
@@ -1547,6 +1550,8 @@ def _wd_audit_ring(name: str, wsk, expected_total: float) -> None:
                 f"{name}: bucket (rung {rung}, id {bid}) ledger"
                 f" {mass:g} != device {got}"
             )
+    for detail in wsk._agg_audit():
+        raise SketchError(f"{name}: stack audit: {detail}")
 
 
 def run_windowed_campaign(
@@ -1562,11 +1567,16 @@ def run_windowed_campaign(
     window and compare bit-identically against the host-side oracle
     merge, round-trip the windowed checkpoint or wire envelope, or
     reshard; armed fault sites tear rotations mid-ingest
-    (``window.rotate_torn``), tear checkpoint writes, corrupt wire
+    (``window.rotate_torn``), tear the two-stacks aggregate sync
+    (``window.stack_torn`` -- the tear must be swallowed, the stacks
+    dropped into the health ledger, and the answers stay oracle-exact),
+    silently corrupt a cached maintained aggregate
+    (``window.agg_stale`` -- only the stack-consistency audit can see
+    it; raw buckets stay clean), tear checkpoint writes, corrupt wire
     envelopes, tear reshards mid-rotation, poison the serve cache, and
     flip the ``SKETCHES_TPU_WINDOWED`` kill switch (which must refuse
-    loudly).  The per-bucket mass ledger is audited with ``==`` after
-    EVERY step.  ``ok`` iff every fault is detected or provably
+    loudly).  The per-bucket mass ledger AND the two-stacks consistency
+    audit run with ``==`` after EVERY step.  ``ok`` iff every fault is detected or provably
     harmless, every oracle comparison is bit-identical, and the ledger
     never breaks.  Raises ``SketchValueError`` for non-positive
     ``steps``; campaign-level failures are reported in the verdict,
@@ -1835,6 +1845,78 @@ def run_windowed_campaign(
             else:
                 _os.environ[_switch] = prior
 
+    def _fault_stack_torn(step: int) -> str:
+        name = ("dense", "adaptive")[step % 2]
+        wsk = rings[name]
+        if not wsk._agg_enabled:
+            return "skipped"  # kill-switch lane: the site never fires
+        clock.advance(float(rng.uniform(5.0, 12.0)))  # rotation due
+        before = resilience.health()["counters"].get(
+            "window.stack_torn", 0
+        )
+        faults.arm(faults.WINDOW_STACK_TORN, times=1)
+        try:
+            wsk.add(_batch())  # sync tears AFTER the rotation commit
+        finally:
+            faults.disarm()
+        expected[name] += _WD_STREAMS * _WD_BATCH
+        if wsk._agg_stacks is not None:
+            return "undetected"  # torn sync left stale stacks behind
+        after = resilience.health()["counters"].get(
+            "window.stack_torn", 0
+        )
+        if after != before + 1:
+            return "undetected"  # the tear went unaccounted
+        # The degraded path must still answer oracle-exactly (the next
+        # plan rebuilds the stacks lazily, zero upfront merges).
+        got = np.asarray(wsk.quantile(_WD_QS, window=30.0))
+        want = np.asarray(oracle_quantile(wsk, _WD_QS, window=30.0))
+        return (
+            "detected"
+            if np.array_equal(got, want, equal_nan=True)
+            else "undetected"
+        )
+
+    def _fault_agg_stale(step: int) -> str:
+        name = ("dense", "adaptive")[step % 2]
+        wsk = rings[name]
+        if not wsk._agg_enabled:
+            return "skipped"  # kill-switch lane: no aggregates exist
+        wsk.quantile(_WD_QS, window=30.0)  # warm the aggregate caches
+        stacks = wsk._agg_stacks
+        if not stacks or (
+            wsk._agg_fold_cache is None and not any(
+                s._combined or s._tails or s.front for s in stacks
+            )
+        ):
+            return "skipped"  # nothing cached yet to corrupt
+        faults.arm(faults.WINDOW_AGG_STALE, times=1)
+        try:
+            wsk.window_plan(30.0)  # plan time applies the stale flips
+        finally:
+            faults.disarm()
+        violations = wsk._agg_audit()
+        if not violations:
+            # The flip landed invisibly to exact content comparison
+            # (the sign bit of a zero count: -0.0 == 0.0) -- then the
+            # answer must still be oracle-exact, or the audit MISSED
+            # real corruption.
+            got = np.asarray(wsk.quantile(_WD_QS, window=30.0))
+            want = np.asarray(oracle_quantile(wsk, _WD_QS, window=30.0))
+            return (
+                "harmless"
+                if np.array_equal(got, want, equal_nan=True)
+                else "undetected"
+            )
+        # Derived state: drop the poisoned caches, rebuild lazily, and
+        # the ring must answer oracle-exactly again.
+        wsk._agg_invalidate()
+        got = np.asarray(wsk.quantile(_WD_QS, window=30.0))
+        want = np.asarray(oracle_quantile(wsk, _WD_QS, window=30.0))
+        ok = not wsk._agg_audit() \
+            and np.array_equal(got, want, equal_nan=True)
+        return "detected" if ok else "undetected"
+
     ops = (
         (_ingest, 0.4),
         (_query_oracle, 0.2),
@@ -1853,6 +1935,8 @@ def run_windowed_campaign(
         "reshard.torn": _fault_reshard_torn,
         "serve.cache_poison": _fault_cache_poison,
         "windowed.kill_switch": _fault_kill_switch,
+        "window.stack_torn": _fault_stack_torn,
+        "window.agg_stale": _fault_agg_stale,
     }
     site_names = tuple(fault_sites)
     try:

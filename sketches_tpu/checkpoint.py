@@ -669,4 +669,9 @@ def _restore_windowed_inner(
         wsk._rotations = int(meta.get("rotations", 0))
         wsk._ladder_collapses = int(meta.get("ladder_collapses", 0))
         wsk._cur = None if meta["cur"] is None else int(meta["cur"])
+        # The two-stacks window aggregates are DERIVED state: they are
+        # never serialized, and the rungs above were assigned behind the
+        # constructor's back -- drop the fresh stacks so the first plan
+        # rebuilds them from the restored ring (counted as a rebuild).
+        wsk._agg_invalidate()
     return wsk

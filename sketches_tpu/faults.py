@@ -54,6 +54,16 @@ site                    simulates
                         retirement plan and the commit (raises at the
                         rotation seam; the ring, ledger, and live bucket
                         must survive bit-identical -- rotation is atomic)
+``window.stack_torn``   a two-stacks aggregate sync interrupted mid-update
+                        (raises inside the sync; the stacks are DERIVED
+                        state, so the ring must swallow the tear, drop
+                        the stacks, and rebuild lazily -- recorded in the
+                        health ledger, never surfaced to the query)
+``window.agg_stale``    silent corruption of a maintained window
+                        aggregate -- consumed by ``WindowedSketch`` via
+                        :func:`agg_stale_flips` (returns flip coordinates
+                        rather than raising; the stack-consistency
+                        integrity audit's adversary)
 ======================  ====================================================
 
 Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
@@ -99,6 +109,8 @@ __all__ = [
     "SERVE_CACHE_POISON",
     "SERVE_QUEUE_OVERFLOW",
     "WINDOW_ROTATE_TORN",
+    "WINDOW_STACK_TORN",
+    "WINDOW_AGG_STALE",
     "SITES",
     "arm",
     "disarm",
@@ -110,6 +122,7 @@ __all__ = [
     "state_bitflips",
     "apply_state_bitflips",
     "cache_poison_flip",
+    "agg_stale_flips",
     "stats",
     "corrupt_blobs",
 ]
@@ -133,6 +146,8 @@ SERVE_STRAGGLER = "serve.straggler"
 SERVE_CACHE_POISON = "serve.cache_poison"
 SERVE_QUEUE_OVERFLOW = "serve.queue_overflow"
 WINDOW_ROTATE_TORN = "window.rotate_torn"
+WINDOW_STACK_TORN = "window.stack_torn"
+WINDOW_AGG_STALE = "window.agg_stale"
 
 SITES = (
     NATIVE_LOAD,
@@ -150,6 +165,8 @@ SITES = (
     SERVE_CACHE_POISON,
     SERVE_QUEUE_OVERFLOW,
     WINDOW_ROTATE_TORN,
+    WINDOW_STACK_TORN,
+    WINDOW_AGG_STALE,
 )
 
 #: Fast-path guard: seams check this module flag before calling
@@ -410,6 +427,45 @@ def apply_state_bitflips(state, flips):
         bins_pos=jnp.asarray(arrays[0]),
         bins_neg=jnp.asarray(arrays[1]),
     )
+
+
+def agg_stale_flips(
+    n_streams: int, n_bins: int
+) -> Tuple[Tuple[int, int, int, int], ...]:
+    """Armed maintained-aggregate corruption coordinates -- the
+    ``window.agg_stale`` site's consumer-side read (it returns data
+    rather than raising, like :func:`state_bitflips`).
+
+    Same coordinate scheme as :func:`state_bitflips` -- each firing
+    yields one ``(store, stream, bin, bit)`` tuple derived
+    deterministically from the plan's seed and its running call count --
+    but aimed at a CACHED two-stacks window aggregate instead of a live
+    bucket state: the raw bucket stays clean, so only the
+    stack-consistency integrity audit can tell the cached answer went
+    stale.  Disarmed (the default) it returns ``()`` after one bool
+    test.  Respects the plan's ``times`` cap.
+    """
+    if not _ACTIVE:
+        return ()
+    plan = _plans.get(WINDOW_AGG_STALE)
+    if plan is None:
+        return ()
+    plan.calls += 1
+    if plan.times is not None and plan.fired >= plan.times:
+        return ()
+    h = binascii.crc32(f"{plan.seed}:{plan.calls}".encode()) & 0xFFFFFFFF
+    store = h & 1
+    stream = (h >> 1) % max(n_streams, 1)
+    bin_ = (h >> 11) % max(n_bins, 1)
+    bit = (h >> 25) % 32
+    plan.fired += 1
+    bump("faults." + WINDOW_AGG_STALE)
+    if tracing._ACTIVE:
+        tracing.record_event(
+            "fault.injected", site=WINDOW_AGG_STALE,
+            coords=str((store, stream, bin_, bit)),
+        )
+    return ((store, stream, bin_, bit),)
 
 
 def cache_poison_flip(n_bytes: int) -> Optional[Tuple[int, int]]:

@@ -1145,7 +1145,14 @@ def check_window(wsk, seam: str = "window") -> IntegrityReport:
     * ``window_ledger`` -- ``total_mass == sum(live bucket masses) +
       retired_mass``;
     * ``window_bucket_mass`` -- each bucket's ledger entry equals the
-      device-side mass of its state (``count`` summed over streams).
+      device-side mass of its state (``count`` summed over streams);
+    * ``window_agg`` -- stack consistency: every CACHED two-stacks
+      maintained aggregate's fingerprint equals the fingerprint of the
+      identical merge tree recomputed from the raw covered bucket
+      states (exact comparison -- the recomputation is deterministic,
+      so a clean cache matches bit-for-bit; the ``window.agg_stale``
+      adversary is exactly what this catches).  Skipped when the
+      maintained layer is disabled or its stacks are dropped.
 
     Every bucket state additionally runs the backend's own
     :func:`check_state` invariants (violations fold into the same
@@ -1163,6 +1170,8 @@ def check_window(wsk, seam: str = "window") -> IntegrityReport:
             f"total {wsk.total_mass:g} != live {live_sum:g} +"
             f" retired {wsk.retired_mass:g}",
         )
+    for detail in wsk._agg_audit():
+        report.add(-1, "window_agg", detail)
     device = wsk.device_masses()
     for rung, bid, mass in buckets:
         got = device.get((rung, bid))

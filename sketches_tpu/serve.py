@@ -1151,6 +1151,215 @@ class SketchServer:
                 values=values, tier="window", deadline_missed=missed
             )
 
+    def quantile_many(
+        self,
+        names: Sequence[str],
+        quantiles: Sequence[float],
+        window: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Dict[str, "ServeResult"]:
+        """The windowed twin of the flush path's same-spec stacking:
+        answer ``quantile(qs, window=W)`` for MANY windowed tenants,
+        folding each tenant's maintained window components to one state
+        and stacking same-spec tenants into ONE fused quantile dispatch
+        -> ``{tenant: ServeResult}``.
+
+        Every tenant keeps its own cache entry under the existing
+        ``(tenant, covered-bucket digest, qs)`` key -- hits are
+        re-verified (poisoned entries quarantine) and misses fill the
+        cache, so a later single-tenant :meth:`quantile` hits the entry
+        this call wrote (the answers are bit-identical: the per-tenant
+        fold is the same maintained component chain, pinned by test).
+        Non-windowed tenants raise ``SpecError``; empty ``names``
+        answers ``{}``; spent deadline budgets raise
+        :class:`DeadlineExceeded`; late answers are returned but
+        counted once per tenant.
+        """
+        qs = tuple(sorted(float(q) for q in quantiles))
+        if not qs:
+            raise SketchValueError("a request needs at least one quantile")
+        names = list(names)
+        if not names:
+            return {}
+        import jax
+        import jax.numpy as jnp
+
+        from sketches_tpu.windows import _fold_mode, _fold_state_for
+
+        with self._lock:
+            tenants = [self._tenant(n) for n in names]
+            for t in tenants:
+                if not self._is_windowed(t):
+                    raise SpecError(
+                        f"tenant {t.name!r} is not time-windowed:"
+                        " quantile_many serves windowed tenants only"
+                    )
+            now = self._clock()
+            self._stats["requests"] += len(names)
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("serve.requests", float(len(names)))
+            budget = (
+                self.config.default_deadline_s
+                if deadline_s is None else float(deadline_s)
+            )
+            if budget <= 0:
+                self._stats["deadline_misses"] += len(names)
+                resilience.bump("serve.deadline_misses", len(names))
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc(
+                        "serve.deadline_misses", float(len(names))
+                    )
+                raise DeadlineExceeded(
+                    "window query batch arrived with a spent deadline"
+                    f" budget ({budget:g}s)"
+                )
+            out: Dict[str, ServeResult] = {}
+            misses: List[Tuple[Any, Any, Tuple, np.ndarray]] = []
+            for t in tenants:
+                plan = t.facade.window_plan(window)
+                fp = plan.fingerprint
+                key = (t.name, plan.digest, qs)
+                if self._cache_enabled:
+                    entry = self._cache.get(key)
+                    if entry is not None:
+                        if faults._ACTIVE:
+                            flip = faults.cache_poison_flip(
+                                entry.values.nbytes
+                            )
+                            if flip is not None:
+                                buf = np.ascontiguousarray(
+                                    entry.values
+                                ).copy()
+                                view = buf.view(np.uint8).reshape(-1)
+                                view[flip[0]] ^= np.uint8(1 << flip[1])
+                                entry.values = buf
+                        live_ok = entry.fp.shape == fp.shape and bool(
+                            np.array_equal(entry.fp, fp)
+                        )
+                        sum_ok = entry.checksum == _payload_checksum(
+                            entry.fp, entry.values
+                        )
+                        if live_ok and sum_ok:
+                            self._stats["cache_hits"] += 1
+                            if telemetry._ACTIVE:
+                                telemetry.counter_inc("serve.cache.hits")
+                            out[t.name] = ServeResult(
+                                values=entry.values.copy(), tier="cache"
+                            )
+                            continue
+                        self._quarantine(key, ctx=None)
+                    self._stats["cache_misses"] += 1
+                    if telemetry._ACTIVE:
+                        telemetry.counter_inc("serve.cache.misses")
+                misses.append((t, plan, key, fp))
+            # Same-spec miss groups: fold each tenant's maintained
+            # components to ONE state, stack along the stream axis, and
+            # decode every tenant in one fused quantile dispatch.
+            groups: Dict[Any, List[int]] = {}
+            for i, (t, plan, _key, _fp) in enumerate(misses):
+                if not plan.states:
+                    dtype = np.dtype(jnp.dtype(t.facade.spec.dtype).name)
+                    self._fill_window_result(
+                        t, plan, _key, _fp, qs,
+                        np.full(
+                            (t.facade.n_streams, len(qs)), np.nan, dtype
+                        ),
+                        out,
+                    )
+                    continue
+                groups.setdefault(t.facade.spec, []).append(i)
+            for spec, idxs in groups.items():
+                if len(idxs) == 1:
+                    t, plan, key, fp = misses[idxs[0]]
+                    values = np.asarray(t.facade.query_plan(plan, qs))
+                    self._stats["dispatches"] += 1
+                    self._fill_window_result(
+                        t, plan, key, fp, qs, values, out
+                    )
+                    continue
+                folded = []
+                for i in idxs:
+                    t, plan, _key, _fp = misses[i]
+                    if plan.components is not None:
+                        # Share the ring's per-digest folded-window
+                        # cache: a repeat stacking on unchanged plans
+                        # contributes zero merges to the fused dispatch.
+                        folded.append(t.facade._agg_fold(plan))
+                        continue
+                    comps = plan.states
+                    if len(comps) == 1:
+                        folded.append(comps[0])
+                    else:
+                        mode = _fold_mode(spec, comps)
+                        folded.append(_fold_state_for(spec)[mode](comps))
+                stacked = jax.tree.map(
+                    lambda *xs: jnp.concatenate(xs, axis=0), *folded
+                )
+                fn = self._fused_quantile(spec)
+                qs_arr = jnp.asarray(qs, spec.dtype)
+                try:
+                    if faults._ACTIVE:
+                        faults.inject(
+                            faults.SERVE_STRAGGLER, tier=_FLOOR_TIER
+                        )
+                    rows = np.asarray(fn(stacked, qs_arr))
+                except SketchError:
+                    if not self._hedge_enabled:
+                        raise
+                    self._stats["hedges"] += 1
+                    resilience.bump("serve.hedges")
+                    if telemetry._ACTIVE:
+                        telemetry.counter_inc(
+                            "serve.hedges", tier=_FLOOR_TIER
+                        )
+                    rows = np.asarray(fn(stacked, qs_arr))
+                self._stats["dispatches"] += 1
+                self._stats["fused_dispatches"] += 1
+                lo = 0
+                for i in idxs:
+                    t, plan, key, fp = misses[i]
+                    hi = lo + t.facade.n_streams
+                    self._fill_window_result(
+                        t, plan, key, fp, qs, rows[lo:hi].copy(), out
+                    )
+                    lo = hi
+            done = self._clock()
+            missed = done > now + budget
+            if missed:
+                self._stats["deadline_misses"] += len(names)
+                resilience.bump("serve.deadline_misses", len(names))
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc(
+                        "serve.deadline_misses", float(len(names))
+                    )
+                for r in out.values():
+                    if r.tier != "cache":
+                        r.deadline_missed = True
+            if telemetry._ACTIVE:
+                for t in tenants:
+                    telemetry.observe(
+                        "serve.request_s", done - now,
+                        source=(
+                            "cache" if out[t.name].tier == "cache"
+                            else "dispatch"
+                        ),
+                    )
+            return out
+
+    def _fill_window_result(
+        self, t, plan, key, fp, qs, values, out
+    ) -> None:
+        """Cache-fill + result-build shared by the quantile_many paths
+        (single-tenant fallback, empty coverage, fused rows)."""
+        if self._cache_enabled:
+            if key not in self._cache:
+                self._cache_order.append(key)
+            self._cache[key] = _CacheEntry(fp, values, "window")
+            while len(self._cache_order) > self.config.cache_capacity:
+                old = self._cache_order.pop(0)
+                self._cache.pop(old, None)
+        out[t.name] = ServeResult(values=values, tier="window")
+
     def query(
         self,
         name: str,

@@ -364,6 +364,19 @@ _DECLARED = (
     Metric("window.covered_buckets", "gauge", "sketches_tpu.windows",
            "Buckets covered by the most recent window query (the fused"
            " stacked-merge dispatch's arity)."),
+    Metric("window.agg_reuse", "counter", "sketches_tpu.windows",
+           "Window plans whose sealed-rung component was served"
+           " entirely from a maintained two-stacks aggregate (zero new"
+           " backend merges -- the maintained layer's hit rate)."),
+    Metric("window.agg_rebuilds", "counter", "sketches_tpu.windows",
+           "Two-stacks aggregate rebuilds: the derived stacks were"
+           " dropped (restore, ring merge, torn sync) and repopulated"
+           " from the ring on the next plan."),
+    Metric("window.query_merges", "counter", "sketches_tpu.windows",
+           "Backend merges spent ANSWERING window queries on the"
+           " maintained path (component chain + suffix/back-tail"
+           " combine) -- O(1) per query, vs O(covered buckets) with"
+           " SKETCHES_TPU_WINDOW_AGG=0."),
 )
 
 #: Every declared metric by name (static inventory + runtime
@@ -1777,6 +1790,10 @@ BENCH_GATE: Tuple[Tuple[str, str, float], ...] = (
     ("configs.c2s_shard_query_131k.merge_per_shard_s", "lower", 0.30),
     ("configs.serde_bulk.to_bytes_s", "lower", 0.40),
     ("configs.serde_bulk.from_bytes_s", "lower", 0.40),
+    # Windowed query latency (r19 two-stacks maintained aggregates):
+    # host-timed fused dispatches, so they breathe like the serde rows.
+    ("configs.windowed.window_query_p50_s", "lower", 0.40),
+    ("configs.windowed.window_query_vs_single_floorsub", "lower", 0.40),
 )
 
 

@@ -71,6 +71,8 @@ from sketches_tpu.resilience import (
     SketchValueError,
     SpecError,
     UnequalSketchParametersError,
+    bump,
+    record_downgrade,
 )
 
 __all__ = [
@@ -219,6 +221,15 @@ class WindowPlan:
     states: Tuple[Any, ...]
     fingerprint: np.ndarray
     digest: bytes
+    #: Maintained-aggregate fast path (``SKETCHES_TPU_WINDOW_AGG=1``):
+    #: the pre-merged component states the fused fold runs over instead
+    #: of every covered bucket, plus one recipe per component naming
+    #: exactly which ``states`` indices it folds and in what tree shape
+    #: (``("raw", i)`` or ``("fold", rung, front idxs, back idxs)``) --
+    #: the contract :func:`oracle_quantile` replays eagerly.  ``None``
+    #: when the kill switch routes through the full re-merge path.
+    components: Optional[Tuple[Any, ...]] = None
+    recipes: Optional[Tuple[Tuple, ...]] = None
 
     @property
     def n_covered(self) -> int:
@@ -309,6 +320,86 @@ def _fold_mode(spec: SketchSpec, states) -> str:
     return "general"
 
 
+#: Fold-to-STATE twin of :data:`_FOLD_CACHE` for the serve tier's
+#: windowed stacking: same per-mode merge chains, but returning the
+#: folded state instead of decoding quantiles -- the per-tenant reduce
+#: that lets same-spec windowed tenants share ONE stacked quantile
+#: dispatch.  Same jit-per-arity sharing discipline.
+_FOLD_STATE_CACHE: Dict[SketchSpec, Dict[str, Callable]] = {}
+
+
+def _fold_state_for(spec: SketchSpec) -> Dict[str, Callable]:
+    fns = _FOLD_STATE_CACHE.get(spec)
+    if fns is not None:
+        return fns
+    if spec.backend == "uniform_collapse":
+        from sketches_tpu.backends import uniform
+
+        def fold(states):
+            acc = states[0]
+            for st in states[1:]:
+                acc = uniform.merge(spec, acc, st)
+            return acc
+
+        fns = {"general": jax.jit(fold)}
+    elif spec.backend == "moment":
+        from sketches_tpu.backends import moment
+
+        def fold_m(states):
+            acc = states[0]
+            for st in states[1:]:
+                acc = moment.merge(spec, acc, st)
+            return acc
+
+        fns = {"general": jax.jit(fold_m)}
+    else:
+
+        def fold_general(states):
+            acc = states[0]
+            for st in states[1:]:
+                acc = batched.merge_aligned(spec, acc, st)
+            return acc
+
+        def fold_aligned(states):
+            acc = states[0]
+            for st in states[1:]:
+                acc = batched.merge(spec, acc, st)
+            return acc
+
+        fns = {
+            "general": jax.jit(fold_general),
+            "aligned": jax.jit(fold_aligned),
+        }
+    _FOLD_STATE_CACHE[spec] = fns
+    return fns
+
+
+#: Single-state quantile twin: decode quantiles from ONE (already
+#: folded) state.  With the per-digest folded-window cache this is the
+#: entire cost of a repeated window query -- the same dispatch a plain
+#: unwindowed facade pays.
+_QUANTILE_CACHE: Dict[SketchSpec, Callable] = {}
+
+
+def _quantile_for(spec: SketchSpec) -> Callable:
+    fn = _QUANTILE_CACHE.get(spec)
+    if fn is not None:
+        return fn
+    if spec.backend == "uniform_collapse":
+        from sketches_tpu.backends import uniform
+
+        fn = jax.jit(functools.partial(uniform.quantile, spec))
+    elif spec.backend == "moment":
+        from sketches_tpu.backends import moment
+
+        def fn(st, qs):  # host maxent solve, like _fold_for's twin
+            return moment.quantile(spec, st, qs)
+    else:
+        fn = jax.jit(functools.partial(batched.quantile, spec))
+    _QUANTILE_CACHE[spec] = fn
+    return fn
+
+
 def _batch_mass(spec: SketchSpec, values, weights) -> float:
     """Exact host-side mass of one ingest batch, matching the device
     tier's ``count`` delta: the sum of positive weights (``w <= 0``
@@ -325,6 +416,140 @@ def _batch_mass(spec: SketchSpec, values, weights) -> float:
     if spec.bins_integer:
         return float(np.trunc(w[live]).sum())
     return float(w[live].sum())
+
+
+class _TwoStacks:
+    """Two-stacks incremental aggregator over ONE rung's *sealed*
+    buckets (the SWAG/DABA shape: arxiv 2101.06758's fold-over-partials
+    framing made O(1) amortized).
+
+    ``front`` holds the older buckets as ``(id, raw state, suffix
+    state)`` entries, oldest first, where ``suffix[j]`` is the RIGHT
+    fold ``raw[j] + (raw[j+1] + (... ))`` over the rest of the front --
+    evicting the oldest entry leaves every remaining suffix valid.
+    ``back`` holds the newer buckets as ``(id, raw state)`` with lazily
+    maintained LEFT-fold tails (``_tails[start id] = (n folded,
+    state)``), each extended by ONE merge when a new bucket lands.
+    When an eviction finds the front empty, the whole back flips into
+    the front (computing its suffixes) -- the classic amortization:
+    every pushed bucket is merged at most once by a flip and at most
+    once by a tail extension, so maintenance costs <= 2 backend merges
+    per rotation amortized.  A window answer over the rung is then ONE
+    merge -- ``front suffix + back tail`` -- plus reuse of whatever is
+    already cached.
+
+    The merge-tree SHAPE is the bit-identity contract: backend merges
+    are deterministic but not associative in floating point, so
+    :meth:`suffix` reports exactly which ids sit in the front/back legs
+    and :func:`oracle_quantile` replays the identical ``right-fold
+    (front) + left-fold(back)`` association eagerly.  All merges go
+    through the owner's counted wrapper; cached states are derived --
+    dropping them is always safe (rebuild is lazy and merge-free).
+    """
+
+    __slots__ = ("_owner", "rung", "front", "back", "_tails", "_combined")
+
+    def __init__(self, owner: "WindowedSketch", rung: int):
+        self._owner = owner
+        self.rung = rung
+        self.front: List[Tuple[int, Any, Any]] = []
+        self.back: List[Tuple[int, Any]] = []
+        #: back start id -> (entries folded from there, left-fold state)
+        self._tails: Dict[int, Tuple[int, Any]] = {}
+        #: front id -> (back length folded, suffix+back-tail state)
+        self._combined: Dict[int, Tuple[int, Any]] = {}
+
+    def ids(self) -> List[int]:
+        return [e[0] for e in self.front] + [e[0] for e in self.back]
+
+    def _merge(self, a, b):
+        o = self._owner
+        o._agg_maint_merges += 1
+        return o._merge_states(a, b)
+
+    def push(self, bid: int, state) -> None:
+        """Append a newly sealed bucket (no merges: tails extend lazily)."""
+        if self.back and bid <= self.back[-1][0]:
+            raise SketchValueError(
+                f"two-stacks push out of order: {bid} after"
+                f" {self.back[-1][0]}"
+            )
+        if self.front and bid <= self.front[-1][0]:
+            raise SketchValueError(
+                f"two-stacks push out of order: {bid} behind front"
+            )
+        self.back.append((bid, state))
+
+    def evict(self, bid: int) -> None:
+        """Drop the oldest sealed bucket (it retired off the rung)."""
+        if not self.front:
+            self._flip()
+        if not self.front or self.front[0][0] != bid:
+            raise SketchValueError(
+                f"two-stacks evict out of order: {bid} is not the oldest"
+            )
+        self.front.pop(0)
+        self._combined.pop(bid, None)
+
+    def _flip(self) -> None:
+        """Move the whole back into the front, computing right-fold
+        suffixes (one merge per entry beyond the first -- the amortized
+        cost every pushed bucket pays at most once)."""
+        acc = None
+        rev: List[Tuple[int, Any, Any]] = []
+        for bid, raw in reversed(self.back):
+            acc = raw if acc is None else self._merge(raw, acc)
+            rev.append((bid, raw, acc))
+        self.front = list(reversed(rev))
+        self.back = []
+        self._tails.clear()
+        self._combined.clear()
+
+    def _back_tail(self, t: int):
+        """Left fold of ``back[t:]``, maintained incrementally: a cached
+        tail extends by one merge per newly pushed bucket."""
+        bid = self.back[t][0]
+        n = len(self.back) - t
+        cached = self._tails.get(bid)
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        if cached is not None and 0 < cached[0] < n:
+            done, acc = cached
+        else:
+            done, acc = 1, self.back[t][1]
+        for i in range(t + done, len(self.back)):
+            acc = self._merge(acc, self.back[i][1])
+        self._tails[bid] = (n, acc)
+        return acc
+
+    def suffix(self, start_bid: int):
+        """The maintained fold of sealed buckets ``start_bid..newest``
+        -> ``(state, front ids folded, back ids folded)`` or ``None``
+        when ``start_bid`` is not a stacked id.  Tree shape: right fold
+        over the front leg ``+`` left fold over the back leg -- the
+        association the oracle replays."""
+        o = self._owner
+        for j, (bid, _raw, sfx) in enumerate(self.front):
+            if bid == start_bid:
+                front_ids = tuple(e[0] for e in self.front[j:])
+                back_ids = tuple(e[0] for e in self.back)
+                if not self.back:
+                    return sfx, front_ids, back_ids
+                cached = self._combined.get(bid)
+                if cached is not None and cached[0] == len(self.back):
+                    return cached[1], front_ids, back_ids
+                tail = self._back_tail(0)
+                o._agg_query_merges += 1
+                st = o._merge_states(sfx, tail)
+                self._combined[bid] = (len(self.back), st)
+                return st, front_ids, back_ids
+        for t, (bid, _raw) in enumerate(self.back):
+            if bid == start_bid:
+                return (
+                    self._back_tail(t), (),
+                    tuple(e[0] for e in self.back[t:]),
+                )
+        return None
 
 
 class WindowedSketch:
@@ -408,6 +633,22 @@ class WindowedSketch:
         self._cur: Optional[int] = None
         self._version = 0  # bumped on every content change (live fp cache)
         self._live_fp: Optional[Tuple[int, np.ndarray]] = None
+        # -- maintained two-stacks window aggregates (derived state) --
+        self._agg_enabled = registry.enabled(registry.WINDOW_AGG)
+        self._agg_stacks: Optional[List[_TwoStacks]] = (
+            [_TwoStacks(self, r) for r in range(self.config.n_rungs)]
+            if self._agg_enabled else None
+        )
+        self._agg_maint_merges = 0
+        self._agg_query_merges = 0
+        self._agg_reuse = 0
+        self._agg_rebuilds = 0
+        # (digest, folded state, component states, fold mode, decode
+        # facade or None) -- the per-plan-digest folded-window cache;
+        # derived, never serialized.
+        self._agg_fold_cache: Optional[
+            Tuple[bytes, Any, Tuple, str, Any]
+        ] = None
 
     # -- construction helpers ---------------------------------------------
 
@@ -512,6 +753,348 @@ class WindowedSketch:
         s = self.config.slices_s[rung]
         return bucket_id * s, (bucket_id + 1) * s
 
+    # -- maintained two-stacks aggregates (derived state) ------------------
+
+    def _seal_cutoff(self, rung: int, now: float) -> int:
+        """Bucket ids of ``rung`` strictly below this can never receive
+        another retirement merge -- they are *sealed* and safe to enter
+        the two-stacks aggregator.  Rung 0's frozen buckets are sealed
+        the moment they freeze (ingest never revisits them); a coarser
+        bucket is sealed once every finer constituent slice has retired
+        past rung ``rung - 1``'s floor (``(bid + 1) * ratio <= floor``).
+        Unsealed ("absorbing") buckets stay out of the stacks and ride
+        the plan as raw components."""
+        if rung == 0:
+            return self._id_at(0, now)
+        floor_finer = (
+            self._id_at(rung - 1, now) - self.config.lengths[rung - 1] + 1
+        )
+        ratio = round(
+            self.config.slices_s[rung] / self.config.slices_s[rung - 1]
+        )
+        # bid is sealed iff every constituent retired past the finer
+        # floor: (bid + 1) * ratio <= floor  <=>  bid < floor // ratio.
+        return floor_finer // ratio
+
+    def _agg_invalidate(self) -> None:
+        """Drop the maintained stacks (merge of rings, restore from a
+        checkpoint/wire image, torn sync): they are derived state, so
+        the next plan rebuilds them lazily with zero upfront merges."""
+        if self._agg_enabled:
+            self._agg_stacks = None
+            self._agg_fold_cache = None
+
+    def _agg_sync(self, now: float) -> None:
+        """Bring the per-rung stacks up to date with the ring: push
+        newly sealed buckets, evict retired ones.  Runs after the
+        rotation COMMIT (and at plan time), so a torn rotation never
+        sees half-updated stacks.  Any failure here -- including the
+        injected ``window.stack_torn`` tear -- is swallowed: the stacks
+        are dropped and rebuilt lazily, recorded in the health ledger;
+        a query can get slower, never wrong and never refused."""
+        if not self._agg_enabled:
+            return
+        try:
+            if faults._ACTIVE:
+                faults.inject(faults.WINDOW_STACK_TORN)
+            if self._agg_stacks is None:
+                self._agg_stacks = [
+                    _TwoStacks(self, r) for r in range(self.config.n_rungs)
+                ]
+                self._agg_rebuilds += 1
+                if telemetry._ACTIVE:
+                    telemetry.counter_inc("window.agg_rebuilds")
+            for r, stack in enumerate(self._agg_stacks):
+                cutoff = self._seal_cutoff(r, now)
+                sealed = sorted(
+                    bid for bid in self._rungs[r] if bid < cutoff
+                )
+                cur_ids = stack.ids()
+                if cur_ids == sealed:
+                    continue
+                sealed_set = set(sealed)
+                gone = [b for b in cur_ids if b not in sealed_set]
+                keep = cur_ids[len(gone):]
+                if cur_ids[: len(gone)] == gone \
+                        and sealed[: len(keep)] == keep:
+                    for bid in gone:
+                        stack.evict(bid)
+                    for bid in sealed[len(keep):]:
+                        stack.push(bid, self._rungs[r][bid].state)
+                else:
+                    # Non-incremental drift (a ring merge or restore
+                    # slipped past the invalidate hooks): rebuild this
+                    # rung's stack from scratch, zero upfront merges.
+                    fresh = _TwoStacks(self, r)
+                    for bid in sealed:
+                        fresh.push(bid, self._rungs[r][bid].state)
+                    self._agg_stacks[r] = fresh
+                    self._agg_rebuilds += 1
+                    if telemetry._ACTIVE:
+                        telemetry.counter_inc("window.agg_rebuilds")
+        except Exception as e:  # noqa: BLE001 - derived state must degrade
+            self._agg_stacks = None
+            bump("window.stack_torn")
+            record_downgrade(
+                "windows.agg", "two-stacks", "rebuild",
+                reason=f"stack sync torn: {e!r}",
+            )
+
+    def _agg_assemble(self, covered):
+        """Assemble the maintained-component list for a covered-bucket
+        plan -> ``(components, recipes)`` -- or ``(None, None)`` when
+        the maintained path cannot serve it (stacks dropped mid-plan).
+
+        Component order is PINNED (the other half of the tree-shape
+        contract): rungs coarsest to finest -- per rung one maintained
+        sealed aggregate (when the covered sealed ids form the stack's
+        newest suffix) then the absorbing raw buckets in id order --
+        and the live bucket last.  Each recipe names the ``covered``
+        indices its component folds, so the oracle replays the exact
+        association from the raw states."""
+        stacks = self._agg_stacks
+        if stacks is None:
+            return None, None
+        components: List[Any] = []
+        recipes: List[Tuple] = []
+        by_rung: Dict[int, List[int]] = {}
+        live_idx: Optional[int] = None
+        for i, (r, _bid, _st, b) in enumerate(covered):
+            if b is None:
+                live_idx = i
+            else:
+                by_rung.setdefault(r, []).append(i)
+        for r in sorted(by_rung, reverse=True):
+            stack = stacks[r]
+            stacked = set(stack.ids())
+            sealed = [i for i in by_rung[r] if covered[i][1] in stacked]
+            loose = [i for i in by_rung[r] if covered[i][1] not in stacked]
+            if sealed:
+                ids_cov = [covered[i][1] for i in sealed]
+                sids = stack.ids()
+                hit = None
+                if sids[-len(ids_cov):] == ids_cov:
+                    before = (
+                        self._agg_maint_merges + self._agg_query_merges
+                    )
+                    hit = stack.suffix(ids_cov[0])
+                if hit is not None:
+                    state, front_ids, back_ids = hit
+                    if before == (
+                        self._agg_maint_merges + self._agg_query_merges
+                    ):
+                        self._agg_reuse += 1
+                        if telemetry._ACTIVE:
+                            telemetry.counter_inc("window.agg_reuse")
+                    idx_of = {covered[i][1]: i for i in sealed}
+                    components.append(state)
+                    recipes.append((
+                        "fold", r,
+                        tuple(idx_of[b] for b in front_ids),
+                        tuple(idx_of[b] for b in back_ids),
+                    ))
+                else:
+                    # Covered sealed ids are not the stack's newest
+                    # suffix (a window ending in the past would do
+                    # this); fall back to raw buckets for this rung.
+                    loose = sealed + loose
+            for i in sorted(loose, key=lambda i: covered[i][1]):
+                components.append(covered[i][2])
+                recipes.append(("raw", i))
+        if live_idx is not None:
+            components.append(covered[live_idx][2])
+            recipes.append(("raw", live_idx))
+        return tuple(components), tuple(recipes)
+
+    def _agg_fold(self, plan: "WindowPlan"):
+        """Fold a maintained-component plan to ONE state, cached by the
+        plan digest.  The digest hashes every covered bucket's
+        ``(rung, id, fingerprint)``, so any rotation, ingest, or
+        restore moves it -- a stale entry can only MISS, never answer
+        wrong.  A hit is the O(1)-merges endgame: a repeat query on an
+        unchanged window decodes straight from the cached folded state,
+        zero merges -- the same single-state dispatch a plain
+        unwindowed facade pays.  The fold itself reuses the per-mode
+        fold-to-state jits, so the merge-tree shape (and hence the
+        bit-exact answer) is identical to the fused fold+quantile
+        path."""
+        states = plan.components
+        if len(states) == 1:
+            return states[0]
+        cached = self._agg_fold_cache
+        if cached is not None and cached[0] == plan.digest:
+            self._agg_reuse += 1
+            if telemetry._ACTIVE:
+                telemetry.counter_inc("window.agg_reuse")
+            return cached[1]
+        mode = _fold_mode(self.spec, states)
+        folded = _fold_state_for(self.spec)[mode](states)
+        self._agg_query_merges += len(states) - 1
+        if telemetry._ACTIVE:
+            telemetry.counter_inc(
+                "window.query_merges", float(len(states) - 1)
+            )
+        self._agg_fold_cache = (
+            plan.digest, folded, states, mode,
+            self._agg_decode_facade(folded),
+        )
+        return folded
+
+    def _agg_decode_facade(self, folded):
+        """Wrap a folded dense window state in a throwaway facade so a
+        fold-cache HIT decodes through the facade's engine ladder (the
+        state-window-planned quantile the single-sketch baseline pays)
+        instead of the full-width decode -- the tiers are answer-
+        identical, so bit-identity to the oracle is unchanged.  Non-
+        dense and mesh-sharded states decode through their own
+        single-state twins; returns None for those."""
+        if self.spec.backend != "dense" or self._distributed:
+            return None
+        return batched.BatchedDDSketch(
+            self._n_streams, spec=self.spec, state=folded
+        )
+
+    def _agg_corrupt(self, flips) -> bool:
+        """Apply ``window.agg_stale`` flip coordinates to the first
+        cached maintained aggregate (raw bucket states stay clean --
+        only the stack-consistency audit can catch the divergence).
+        The folded-window cache is corrupted first when present: it is
+        the most query-visible cached aggregate.  Returns whether
+        anything was corrupted; moment states carry no bin stores to
+        flip, so the site no-ops there."""
+        if not flips or self._agg_stacks is None \
+                or self.spec.backend == "moment":
+            return False
+
+        def corrupt(st):
+            if self.spec.backend == "uniform_collapse":
+                return dataclasses.replace(
+                    st, base=faults.apply_state_bitflips(st.base, flips)
+                )
+            return faults.apply_state_bitflips(st, flips)
+
+        if self._agg_fold_cache is not None:
+            digest, folded, states, mode, _fac = self._agg_fold_cache
+            bad = corrupt(folded)
+            # Rebuild the decode facade around the corrupted state so
+            # the corruption stays query-visible, not just audit-visible.
+            self._agg_fold_cache = (
+                digest, bad, states, mode, self._agg_decode_facade(bad)
+            )
+            return True
+        for stack in self._agg_stacks:
+            if stack._combined:
+                bid, (n, st) = sorted(stack._combined.items())[0]
+                stack._combined[bid] = (n, corrupt(st))
+                return True
+            if stack._tails:
+                bid, (n, st) = sorted(stack._tails.items())[0]
+                stack._tails[bid] = (n, corrupt(st))
+                return True
+            if stack.front:
+                bid, raw, sfx = stack.front[0]
+                stack.front[0] = (bid, raw, corrupt(sfx))
+                return True
+        return False
+
+    def _agg_audit(self) -> List[str]:
+        """Stack-consistency audit: recompute every CACHED maintained
+        aggregate from its raw constituent states through the identical
+        merge tree and compare content leaf-for-leaf exactly (the
+        recomputation is deterministic, so a clean cache matches
+        bit-for-bit; the weighted-sum fingerprint digest would absorb a
+        low-bit flip on an empty bin into float64 rounding, so the
+        audit compares the raw buffers instead).  Returns violation
+        detail strings; disabled or dropped stacks audit clean (there
+        is nothing cached to trust).  Never mutates the ring."""
+        out: List[str] = []
+        if not self._agg_enabled or self._agg_stacks is None:
+            return out
+
+        def mismatch(expect, got) -> bool:
+            ea, ga = jax.tree.leaves(expect), jax.tree.leaves(got)
+            return len(ea) != len(ga) or any(
+                not np.array_equal(
+                    np.asarray(jax.device_get(x)),
+                    np.asarray(jax.device_get(y)),
+                )
+                for x, y in zip(ea, ga)
+            )
+
+        for stack in self._agg_stacks:
+            r = stack.rung
+            # Front suffixes: suffix[j] == right fold of front raws [j:].
+            acc = None
+            for bid, raw, sfx in reversed(stack.front):
+                acc = raw if acc is None else self._merge_states(raw, acc)
+                if mismatch(acc, sfx):
+                    out.append(
+                        f"rung {r} front suffix @{bid} diverges from its"
+                        " raw right-fold"
+                    )
+            # Back tails: _tails[bid] == left fold of back raws from bid.
+            back_pos = {b: t for t, (b, _s) in enumerate(stack.back)}
+            for bid, (n, st) in sorted(stack._tails.items()):
+                t = back_pos.get(bid)
+                if t is None or t + n > len(stack.back):
+                    out.append(f"rung {r} back tail @{bid} orphaned")
+                    continue
+                acc = stack.back[t][1]
+                for i in range(t + 1, t + n):
+                    acc = self._merge_states(acc, stack.back[i][1])
+                if mismatch(acc, st):
+                    out.append(
+                        f"rung {r} back tail @{bid} diverges from its"
+                        " raw left-fold"
+                    )
+            # Combined: _combined[bid] == suffix(bid) + left fold of
+            # the first ``n`` back raws (the recorded back length).
+            front_pos = {b: j for j, (b, _r, _s) in enumerate(stack.front)}
+            for bid, (n, st) in sorted(stack._combined.items()):
+                j = front_pos.get(bid)
+                if j is None or n > len(stack.back) or n < 1:
+                    out.append(f"rung {r} combined @{bid} orphaned")
+                    continue
+                acc = None
+                for fbid, raw, _s in reversed(stack.front[j:]):
+                    acc = (
+                        raw if acc is None
+                        else self._merge_states(raw, acc)
+                    )
+                tail = stack.back[0][1]
+                for i in range(1, n):
+                    tail = self._merge_states(tail, stack.back[i][1])
+                exp = self._merge_states(acc, tail)
+                if mismatch(exp, st):
+                    out.append(
+                        f"rung {r} combined @{bid} diverges from its"
+                        " raw fold"
+                    )
+        cache = self._agg_fold_cache
+        if cache is not None:
+            _digest, folded, comp_states, mode, _fac = cache
+            exp = _fold_state_for(self.spec)[mode](comp_states)
+            if mismatch(exp, folded):
+                out.append(
+                    "folded-window cache diverges from its component"
+                    " re-fold"
+                )
+        return out
+
+    def agg_stats(self) -> Dict[str, float]:
+        """The maintained-aggregate scoreboard: whether the layer is on,
+        merges spent maintaining the stacks (flips + tail extensions --
+        the <= 2-per-rotation amortized budget the tests pin), merges
+        spent answering queries, component reuses, and stack rebuilds.
+        Never raises."""
+        return {
+            "enabled": float(self._agg_enabled),
+            "maintenance_merges": float(self._agg_maint_merges),
+            "query_merges": float(self._agg_query_merges),
+            "reuse": float(self._agg_reuse),
+            "rebuilds": float(self._agg_rebuilds),
+        }
+
     # -- rotation ----------------------------------------------------------
 
     def _roll(self, now: float) -> None:
@@ -594,6 +1177,9 @@ class WindowedSketch:
         self._retired += retired
         self._version += 1
         self._live_fp = None
+        # Content moved between buckets -> the plan digest moves; the
+        # folded-window cache could only miss, so drop it now.
+        self._agg_fold_cache = None
         if telemetry._ACTIVE:
             if rotations:
                 telemetry.counter_inc("window.rotations", float(rotations))
@@ -603,6 +1189,10 @@ class WindowedSketch:
                 )
             if retired:
                 telemetry.counter_inc("window.retired_mass", retired)
+        # Stacks sync strictly AFTER the commit: a torn rotation above
+        # never sees half-updated aggregates, and a torn sync here only
+        # drops derived state (rebuilt lazily), never the ring.
+        self._agg_sync(now)
 
     # -- write path --------------------------------------------------------
 
@@ -626,6 +1216,10 @@ class WindowedSketch:
         self._total += mass
         self._version += 1
         self._live_fp = None
+        # Ingest donates the live state's buffers and moves the plan
+        # digest, so a cached folded window is both dead (can only
+        # miss) and unsafe to re-audit: drop it.
+        self._agg_fold_cache = None
         return self
 
     def merge(self, other: "WindowedSketch") -> "WindowedSketch":
@@ -677,6 +1271,9 @@ class WindowedSketch:
         self._retired += other._retired
         self._version += 1
         self._live_fp = None
+        # A ring merge rewrites sealed states in place (same-id twins
+        # fold); the stacks hold stale references -- drop and rebuild.
+        self._agg_invalidate()
         return self
 
     def reshard(self, mesh=None, n_devices: Optional[int] = None,
@@ -764,7 +1361,18 @@ class WindowedSketch:
         """
         now = self._clock()
         self._roll(now)
+        components = recipes = None
+        if self._agg_enabled:
+            self._agg_sync(now)  # rebuild if dropped; no-op when current
+            if faults._ACTIVE:
+                flips = faults.agg_stale_flips(
+                    self._n_streams, getattr(self.spec, "n_bins", 1)
+                )
+                if flips:
+                    self._agg_corrupt(flips)
         covered = self._covered(window_s, now)
+        if self._agg_enabled:
+            components, recipes = self._agg_assemble(covered)
         fps = [self._bucket_fp(b, st) for (_, _, st, b) in covered]
         h = hashlib.sha256()
         h.update(b"window")
@@ -787,6 +1395,8 @@ class WindowedSketch:
             states=tuple(st for _, _, st, _ in covered),
             fingerprint=fingerprint,
             digest=h.digest(),
+            components=components,
+            recipes=recipes,
         )
 
 
@@ -801,9 +1411,28 @@ class WindowedSketch:
                 (self._n_streams, len(qs)), np.nan,
                 np.dtype(jnp.dtype(self.spec.dtype).name),
             )
-        mode = _fold_mode(self.spec, plan.states)
+        states = plan.states
+        if plan.components is not None:
+            # Maintained-aggregate path: fold the O(1) pre-merged
+            # components once per plan digest, then decode from the
+            # single folded state; a repeat query on an unchanged
+            # window hits the fold cache and pays zero merges -- and
+            # (dense) rides the cached facade's engine ladder, the
+            # exact dispatch a plain unwindowed query pays.
+            folded = self._agg_fold(plan)
+            cache = self._agg_fold_cache
+            if (
+                cache is not None
+                and cache[0] == plan.digest
+                and cache[4] is not None
+            ):
+                return cache[4].get_quantile_values(qs)
+            return _quantile_for(self.spec)(
+                folded, jnp.asarray(qs, self.spec.dtype)
+            )
+        mode = _fold_mode(self.spec, states)
         return _fold_for(self.spec)[mode](
-            plan.states, jnp.asarray(qs, self.spec.dtype)
+            states, jnp.asarray(qs, self.spec.dtype)
         )
 
     def quantile(
@@ -945,6 +1574,12 @@ def oracle_quantile(
 
     The windowed query must be bit-identical to this -- the exactness
     contract ``tests/test_windows.py`` and the chaos campaign pin.
+    Under the maintained-aggregate path (``SKETCHES_TPU_WINDOW_AGG=1``)
+    the oracle replays the plan's component recipes EAGERLY from the
+    raw covered states -- right fold over each sealed front leg, left
+    fold over each back leg, then the component chain -- the identical
+    association the two-stacks layer maintains, so bit-identity holds
+    by symmetry whether an answer came from cache or was just rebuilt.
     Empty coverage answers NaN like the query itself; never mutates
     the ring beyond the same rotation the query would perform.
     """
@@ -956,14 +1591,38 @@ def oracle_quantile(
             np.dtype(jnp.dtype(wsk.spec.dtype).name),
         )
     spec = wsk.spec
-    if _fold_mode(spec, plan.states) == "aligned":
+    if plan.recipes is not None:
+        comps = []
+        for rcp in plan.recipes:
+            if rcp[0] == "raw":
+                comps.append(plan.states[rcp[1]])
+                continue
+            _, _r, front_idx, back_idx = rcp
+            acc = None
+            for i in reversed(front_idx):  # right fold over the front leg
+                st = plan.states[i]
+                acc = st if acc is None else wsk._merge_states(st, acc)
+            tail = None
+            for i in back_idx:  # left fold over the back leg
+                st = plan.states[i]
+                tail = st if tail is None else wsk._merge_states(tail, st)
+            if acc is None:
+                comps.append(tail)
+            elif tail is None:
+                comps.append(acc)
+            else:
+                comps.append(wsk._merge_states(acc, tail))
+        states = tuple(comps)
+    else:
+        states = plan.states
+    if _fold_mode(spec, states) == "aligned":
         # The identical host-side mode choice the fused fold makes:
         # aligned dense windows merge elementwise (no recenter rolls).
         acc = functools.reduce(
-            functools.partial(batched.merge, spec), plan.states
+            functools.partial(batched.merge, spec), states
         )
     else:
-        acc = functools.reduce(wsk._merge_states, plan.states)
+        acc = functools.reduce(wsk._merge_states, states)
     if spec.backend == "moment":
         from sketches_tpu.backends import moment
 

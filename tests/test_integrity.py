@@ -747,6 +747,88 @@ def _detect_window_rotate_torn():
     )
 
 
+def _detect_window_stack_torn():
+    """A torn two-stacks aggregate sync is SWALLOWED (the stacks are
+    derived state): they are dropped into the health ledger and the
+    next window answer is still oracle-exact through the lazy rebuild
+    -- a query can get slower, never wrong and never refused.  Under
+    ``SKETCHES_TPU_WINDOW_AGG=0`` the site never fires: the kill
+    switch itself is the proof."""
+    from sketches_tpu.windows import (
+        VirtualClock,
+        WindowConfig,
+        WindowedSketch,
+        oracle_quantile,
+    )
+
+    clk = VirtualClock(0.0)
+    w = WindowedSketch(
+        8, spec=SPEC,
+        config=WindowConfig(slices_s=(5.0,), lengths=(2,)), clock=clk,
+    )
+    if not w._agg_enabled:
+        return True  # kill-switch lane: no stacks exist to tear
+    w.add(np.full((8, 16), 1.5, np.float32))
+    clk.advance(7.0)  # rotation due: the sync runs AFTER the commit
+    before = resilience.health()["counters"].get("window.stack_torn", 0)
+    faults.arm(faults.WINDOW_STACK_TORN, times=1)
+    try:
+        w.add(np.full((8, 16), 2.5, np.float32))  # tear swallowed
+    finally:
+        faults.disarm()
+    after = resilience.health()["counters"].get("window.stack_torn", 0)
+    got = np.asarray(w.quantile([0.5, 0.9], window=None))
+    want = np.asarray(oracle_quantile(w, [0.5, 0.9], window=None))
+    return (
+        after == before + 1  # the tear is ledger-accounted
+        and np.array_equal(got, want, equal_nan=True)
+        and not w._agg_audit()  # the rebuilt stacks audit clean
+    )
+
+
+def _detect_window_agg_stale():
+    """A silently corrupted CACHED maintained aggregate (raw buckets
+    stay clean, so only the stack-consistency audit can see it) is
+    flagged by ``check_window``'s ``window_agg`` invariant; dropping
+    the derived caches restores oracle-exact answers.  Under
+    ``SKETCHES_TPU_WINDOW_AGG=0`` no aggregates exist to corrupt."""
+    from sketches_tpu.windows import (
+        VirtualClock,
+        WindowConfig,
+        WindowedSketch,
+        oracle_quantile,
+    )
+
+    clk = VirtualClock(0.0)
+    w = WindowedSketch(
+        8, spec=SPEC,
+        config=WindowConfig(slices_s=(5.0, 20.0), lengths=(3, 3)),
+        clock=clk,
+    )
+    if not w._agg_enabled:
+        return True  # kill-switch lane: no cached aggregates exist
+    rng = np.random.default_rng(31)
+    for _ in range(12):
+        clk.advance(5.0)
+        w.add(rng.lognormal(0.0, 0.7, (8, 16)).astype(np.float32))
+    w.quantile([0.5, 0.9], window=30.0)  # warm the aggregate caches
+    faults.arm(faults.WINDOW_AGG_STALE, times=1)
+    try:
+        w.window_plan(30.0)  # plan time applies the stale flips
+    finally:
+        faults.disarm()
+    report = integrity.check_window(w)
+    flagged = report.counters.get("window_agg", 0) > 0
+    w._agg_invalidate()  # derived state: drop and rebuild lazily
+    got = np.asarray(w.quantile([0.5, 0.9], window=30.0))
+    want = np.asarray(oracle_quantile(w, [0.5, 0.9], window=30.0))
+    return (
+        flagged
+        and not w._agg_audit()
+        and np.array_equal(got, want, equal_nan=True)
+    )
+
+
 #: Every injectable site maps to a detector proof -- the closure the
 #: satellite task demands: no silently undetectable fault site.
 _SITE_DETECTORS = {
@@ -765,6 +847,8 @@ _SITE_DETECTORS = {
     faults.SERVE_QUEUE_OVERFLOW: _detect_serve_queue_overflow,
     faults.SERVE_CACHE_POISON: _detect_serve_cache_poison,
     faults.WINDOW_ROTATE_TORN: _detect_window_rotate_torn,
+    faults.WINDOW_STACK_TORN: _detect_window_stack_torn,
+    faults.WINDOW_AGG_STALE: _detect_window_agg_stale,
 }
 
 

@@ -43,6 +43,10 @@ _ARMED = registry.enabled(registry.WINDOWED)
 needs_windowed = pytest.mark.skipif(
     not _ARMED, reason="SKETCHES_TPU_WINDOWED=0 (loud-refusal lane)"
 )
+needs_agg = pytest.mark.skipif(
+    not registry.enabled(registry.WINDOW_AGG),
+    reason="SKETCHES_TPU_WINDOW_AGG=0 (full re-merge fallback lane)",
+)
 
 DENSE = SketchSpec(relative_accuracy=0.02, n_bins=128)
 ADAPTIVE = SketchSpec(
@@ -424,6 +428,227 @@ class TestRotationAtomicity:
 
 
 # ---------------------------------------------------------------------------
+# Incremental two-stacks window aggregation (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+@needs_windowed
+class TestWindowAgg:
+    def test_registry_declared(self):
+        v = registry.lookup("SKETCHES_TPU_WINDOW_AGG")
+        assert v.default == "1" and v.owner == "sketches_tpu.windows"
+
+    def test_metrics_declared(self):
+        for name in (
+            "window.agg_reuse",
+            "window.agg_rebuilds",
+            "window.query_merges",
+        ):
+            assert telemetry.METRICS[name].kind == "counter"
+
+    def test_sites_declared(self):
+        assert faults.WINDOW_STACK_TORN in faults.SITES
+        assert faults.WINDOW_AGG_STALE in faults.SITES
+
+    def test_disarmed_parity(self, monkeypatch):
+        """``SKETCHES_TPU_WINDOW_AGG=0`` falls back to the full
+        re-merge: plans carry no maintained components and the answer
+        is still bit-identical to the oracle -- the kill switch
+        degrades cost, never correctness."""
+        monkeypatch.setenv(registry.WINDOW_AGG.name, "0")
+        w, clk = _ring(n=4)
+        rng = np.random.default_rng(17)
+        _drive(w, clk, rng, 10, batch=8)
+        assert w.agg_stats()["enabled"] == 0.0
+        plan = w.window_plan(25.0)
+        assert plan.components is None and plan.recipes is None
+        got = np.asarray(w.quantile([0.5, 0.99], window=25.0))
+        want = np.asarray(oracle_quantile(w, [0.5, 0.99], window=25.0))
+        assert np.array_equal(got, want, equal_nan=True)
+
+    @needs_agg
+    def test_amortized_maintenance_budget(self):
+        """The two-stacks letter: <= 2 maintenance merges per rotation,
+        amortized over the run (flips + lazy back-tail extensions)."""
+        w, clk = _ring()
+        rng = np.random.default_rng(18)
+        for step in range(40):
+            clk.advance(float(rng.uniform(2.0, 6.0)))
+            w.add(rng.lognormal(0, 0.7, (N, 8)).astype(np.float32))
+            if step % 3 == 0:
+                w.quantile([0.5], window=30.0)
+        stats = w.agg_stats()
+        rotations = w.ledger()["rotations"]
+        assert rotations >= 10  # the drive crossed real boundaries
+        assert stats["maintenance_merges"] <= 2 * rotations
+        assert stats["rebuilds"] <= 1  # the initial lazy build only
+
+    @needs_agg
+    def test_query_is_one_merge_of_maintained_states(self):
+        """A warm window query folds O(1) maintained components (one
+        per rung, plus absorbing raw buckets and at most one live
+        bucket), not O(covered buckets); an unchanged replan reuses
+        the cached aggregates with ZERO new merges."""
+        w, clk = _ring()
+        rng = np.random.default_rng(19)
+        _drive(w, clk, rng, 16, dt=(4.0, 6.0), batch=8)
+        plan = w.window_plan(None)
+        assert plan.components is not None
+        assert plan.n_covered >= 4  # genuinely multi-bucket
+        folds = [r for r in plan.recipes if r[0] == "fold"]
+        assert folds  # at least one maintained aggregate served
+        assert len(plan.components) < plan.n_covered
+        s1 = w.agg_stats()
+        plan2 = w.window_plan(None)
+        s2 = w.agg_stats()
+        assert s2["maintenance_merges"] == s1["maintenance_merges"]
+        assert s2["query_merges"] == s1["query_merges"]
+        assert s2["reuse"] > s1["reuse"]
+        got = np.asarray(w.query_plan(plan2, [0.5, 0.99]))
+        want = np.asarray(oracle_quantile(w, [0.5, 0.99], window=None))
+        assert np.array_equal(got, want, equal_nan=True)
+
+    @needs_agg
+    def test_query_merge_telemetry(self):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            w, clk = _ring()
+            rng = np.random.default_rng(27)
+            _drive(w, clk, rng, 12, dt=(4.0, 6.0), batch=8)
+            w.quantile([0.5], window=30.0)
+            w._agg_invalidate()  # force a counted lazy rebuild
+            w.quantile([0.9], window=30.0)
+            counters = telemetry.snapshot()["counters"]
+            assert counters.get("window.query_merges", 0) >= 1
+            assert counters.get("window.agg_rebuilds", 0) >= 1
+            assert counters.get("window.agg_reuse", 0) >= 1
+        finally:
+            telemetry.disable()
+            telemetry.reset()
+
+    def test_ladder_boundary_5s_to_1m_dense(self):
+        """Queries spanning rung retirements (5 s slices retiring into
+        1 m buckets) through the maintained stacks stay bit-identical
+        to the oracle, with the ledger exact across the boundary."""
+        cfg = WindowConfig(slices_s=(5.0, 60.0), lengths=(12, 2))
+        w, clk = _ring(config=cfg, n=4)
+        rng = np.random.default_rng(20)
+        for _ in range(40):
+            clk.advance(float(rng.uniform(3.0, 8.0)))
+            w.add(rng.lognormal(0, 0.7, (4, 8)).astype(np.float32))
+        for win in (70.0, 130.0, None):
+            got = np.asarray(w.quantile([0.25, 0.5, 0.99], window=win))
+            want = np.asarray(
+                oracle_quantile(w, [0.25, 0.5, 0.99], window=win)
+            )
+            assert np.array_equal(got, want, equal_nan=True), win
+        led = w.ledger()
+        assert led["rotations"] > 12 and led["retired"] > 0
+        assert led["total"] == led["live"] + led["retired"]
+        assert not w._agg_audit()
+
+    @pytest.mark.slow
+    def test_ladder_boundary_collapse_on_retire_adaptive(self):
+        """5 s -> 1 m collapse-on-retire: the maintained-stack answer
+        stays bit-identical to the oracle across the rung boundary and
+        the coarser rung reports its degraded effective alpha."""
+        cfg = WindowConfig(
+            slices_s=(5.0, 60.0), lengths=(12, 1), collapse_levels=(0, 2)
+        )
+        w, clk = _ring(spec=ADAPTIVE, config=cfg, n=4)
+        rng = np.random.default_rng(21)
+        for _ in range(26):
+            clk.advance(6.0)
+            w.add(rng.lognormal(0, 0.7, (4, 8)).astype(np.float32))
+        assert w.ledger()["ladder_collapses"] > 0
+        for win in (70.0, None):
+            got = np.asarray(w.quantile([0.5, 0.99], window=win))
+            want = np.asarray(
+                oracle_quantile(w, [0.5, 0.99], window=win)
+            )
+            assert np.array_equal(got, want, equal_nan=True), win
+        alphas = w.rung_effective_alpha()
+        assert alphas[1] > alphas[0]
+        assert not w._agg_audit()
+
+    @needs_agg
+    def test_restore_rebuilds_stacks(self, tmp_path):
+        """Stacks are DERIVED state: never serialized; a restored ring
+        starts without them and the first plan rebuilds (counted),
+        answering bit-identically to its own oracle."""
+        w, clk = _ring()
+        rng = np.random.default_rng(22)
+        _drive(w, clk, rng, 10)
+        w.quantile([0.5], window=25.0)  # the source ring has live stacks
+        path = str(tmp_path / "w.ckpt")
+        checkpoint.save_windowed(path, w)
+        restored = checkpoint.restore_windowed(
+            path, clock=VirtualClock(clk.t)
+        )
+        assert restored.agg_stats()["rebuilds"] == 0.0
+        got = np.asarray(restored.quantile([0.5, 0.9], window=25.0))
+        want = np.asarray(
+            oracle_quantile(restored, [0.5, 0.9], window=25.0)
+        )
+        assert np.array_equal(got, want, equal_nan=True)
+        assert restored.agg_stats()["rebuilds"] == 1.0
+        assert not restored._agg_audit()
+
+    @needs_agg
+    def test_wire_restore_rebuilds_stacks(self):
+        w, clk = _ring()
+        rng = np.random.default_rng(23)
+        _drive(w, clk, rng, 8)
+        w.quantile([0.5], window=25.0)
+        blob = windowed_to_bytes(w)
+        restored = windowed_from_bytes(
+            DENSE, blob, clock=VirtualClock(clk.t)
+        )
+        assert restored.agg_stats()["rebuilds"] == 0.0
+        got = np.asarray(restored.quantile([0.5, 0.9], window=25.0))
+        want = np.asarray(
+            oracle_quantile(restored, [0.5, 0.9], window=25.0)
+        )
+        assert np.array_equal(got, want, equal_nan=True)
+        assert restored.agg_stats()["rebuilds"] >= 1.0
+
+    def test_ring_merge_invalidates_stacks(self):
+        """merge() rewrites sealed states in place, so the maintained
+        stacks are dropped and rebuilt -- the merged answer still
+        equals the oracle and the rebuilt stacks audit clean."""
+        wa, clk_a = _ring()
+        wb, clk_b = _ring()
+        rng = np.random.default_rng(24)
+        for clk, w in ((clk_a, wa), (clk_b, wb)):
+            for _ in range(8):
+                clk.advance(3.0)
+                w.add(rng.lognormal(0, 0.7, (N, 8)).astype(np.float32))
+        wa.quantile([0.5], window=25.0)  # live stacks before the merge
+        wa.merge(wb)
+        got = np.asarray(wa.quantile([0.5, 0.99], window=25.0))
+        want = np.asarray(oracle_quantile(wa, [0.5, 0.99], window=25.0))
+        assert np.array_equal(got, want, equal_nan=True)
+        assert not wa._agg_audit()
+
+    @needs_agg
+    def test_stale_aggregate_caught_by_check_window(self):
+        """A corrupted cached aggregate (raw buckets clean) surfaces
+        as the ``window_agg`` invariant in check_window; dropping the
+        derived caches restores a clean report."""
+        w, clk = _ring()
+        rng = np.random.default_rng(25)
+        _drive(w, clk, rng, 10)
+        w.quantile([0.5], window=25.0)
+        assert not integrity.check_window(w)
+        assert w._agg_corrupt(((0, 1, 7, 5),))
+        report = integrity.check_window(w)
+        assert report.counters.get("window_agg", 0) > 0
+        w._agg_invalidate()
+        assert not integrity.check_window(w)
+
+
+# ---------------------------------------------------------------------------
 # Checkpoint: ring + ladder + ledger, atomically
 # ---------------------------------------------------------------------------
 
@@ -659,6 +884,50 @@ class TestServe:
 
         with pytest.raises(DeadlineExceeded):
             srv.quantile("w", [0.5], window=15.0, deadline_s=0.0)
+
+    def test_quantile_many_stacks_one_fused_dispatch(self):
+        """Same-spec windowed tenants stack their maintained fold
+        states into ONE fused dispatch; every row is bit-identical to
+        the tenant's direct plan answer and fills the same cache the
+        single-tenant path reads (cross-hits)."""
+        clk = VirtualClock(100.0)
+        srv = serve.SketchServer(clock=clk)
+        rng = np.random.default_rng(26)
+        for t in ("a", "b", "c"):
+            srv.add_tenant(t, 4, window=CFG, spec=DENSE)
+            for _ in range(4):
+                clk.advance(2.0)
+                srv.ingest(
+                    t, rng.lognormal(0, 0.5, (4, 16)).astype(np.float32)
+                )
+        before = srv.stats()["fused_dispatches"]
+        out = srv.quantile_many(["a", "b", "c"], [0.5, 0.99], window=15.0)
+        assert set(out) == {"a", "b", "c"}
+        assert srv.stats()["fused_dispatches"] == before + 1
+        for t in ("a", "b", "c"):
+            facade = srv.tenant(t)
+            direct = np.asarray(
+                facade.query_plan(facade.window_plan(15.0), (0.5, 0.99))
+            )
+            assert np.array_equal(
+                out[t].values, direct, equal_nan=True
+            ), t
+            assert out[t].tier == "window"
+        # Cross-hits: the single-tenant path reads the SAME entries.
+        assert srv.quantile("a", [0.5, 0.99], window=15.0).cached
+        out2 = srv.quantile_many(["a", "b"], [0.5, 0.99], window=15.0)
+        assert all(r.tier == "cache" for r in out2.values())
+
+    def test_quantile_many_edge_cases(self):
+        srv, clk, rng = self._server()
+        from sketches_tpu.resilience import DeadlineExceeded
+
+        assert srv.quantile_many([], [0.5], window=15.0) == {}
+        srv.add_tenant("p", 4, spec=DENSE)
+        with pytest.raises(SpecError, match="not time-windowed"):
+            srv.quantile_many(["w", "p"], [0.5], window=15.0)
+        with pytest.raises(DeadlineExceeded):
+            srv.quantile_many(["w"], [0.5], window=15.0, deadline_s=0.0)
 
 
 # ---------------------------------------------------------------------------
