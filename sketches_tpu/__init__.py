@@ -67,8 +67,11 @@ from sketches_tpu.parallel import DistributedDDSketch
 from sketches_tpu import backends
 from sketches_tpu import windows
 from sketches_tpu.windows import WindowConfig, WindowedSketch
+from sketches_tpu import fabric
+from sketches_tpu.fabric import FabricConfig, ServeFabric
+from sketches_tpu.resilience import FabricUnavailable, ReplicaStale
 
-__version__ = "0.17.0"
+__version__ = "0.18.0"
 
 __all__ = [
     "BaseDDSketch",
@@ -115,6 +118,13 @@ __all__ = [
     "windows",
     "WindowConfig",
     "WindowedSketch",
+    # Sharded serve fabric (rendezvous placement, fingerprint-verified
+    # replicas, failover with exact dropped-mass accounting)
+    "fabric",
+    "FabricConfig",
+    "ServeFabric",
+    "FabricUnavailable",
+    "ReplicaStale",
     "ServeOverload",
     "DeadlineExceeded",
     "IntegrityError",

@@ -42,6 +42,7 @@ __all__ = [
     "ADAPTIVE",
     "WINDOWED",
     "WINDOW_AGG",
+    "FABRIC",
     "REGISTRY",
     "declared",
     "get",
@@ -254,6 +255,19 @@ WINDOW_AGG = EnvVar(
     ),
 )
 
+#: Sharded-serve-fabric kill switch (``sketches_tpu.fabric``).
+FABRIC = EnvVar(
+    name="SKETCHES_TPU_FABRIC",
+    default="1",
+    owner="sketches_tpu.fabric",
+    doc=(
+        "Set to 0 to refuse the sharded serve fabric: constructing a"
+        " ServeFabric raises SpecError instead of silently serving"
+        " unreplicated; single-process SketchServer tenants are"
+        " unaffected."
+    ),
+)
+
 #: Every SKETCHES_TPU_* variable the package reads, by name.  Keep the
 #: docs in sync with the README "Kill switches" table -- the ``registry-doc``
 #: lint rule cross-checks both directions.
@@ -263,6 +277,7 @@ REGISTRY: Dict[str, EnvVar] = {
         NATIVE, OVERLAP, FAULTS, TELEMETRY, INTEGRITY, PROFILING,
         ACCURACY_AUDIT, SERVE_CACHE, SERVE_HEDGE, ELASTIC,
         FLIGHT_RECORDER, INGEST_PACKED, ADAPTIVE, WINDOWED, WINDOW_AGG,
+        FABRIC,
     )
 }
 

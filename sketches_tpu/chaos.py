@@ -68,6 +68,21 @@ every step, a torn rotation/reshard leaves the ring bit-identical, and
 a poisoned serve cache entry recomputes -- anything else is
 ``undetected`` and fails the run.
 
+``--campaign fabric`` runs the SHARDED-SERVE campaign: a 4-host
+:class:`sketches_tpu.fabric.ServeFabric` serves 4 tenants at
+replication 3 while hosts are killed mid-ingest (tenants must re-home
+onto fingerprint-verified replicas, dropped mass itemized EXACTLY per
+stream), primaries are partitioned (reads degrade to declared-staleness
+replicas, writes refuse, beyond-bound replicas refuse loudly), replica
+state is silently corrupted (``fabric.replica_stale`` -- a corrupt
+replica must NEVER serve), partition heals and replica handoffs tear
+atomically, and the ``SKETCHES_TPU_FABRIC`` kill switch flips.  The
+accounting contract: every served answer bit-identical to its oracle
+fold, the mass ledger closes with ``==`` at every step and every
+failover -- anything else is ``undetected`` and fails the run.  With
+the switch disarmed the campaign probes that every construction
+refuses loudly instead.
+
 Failure modes: the harness itself raises ``SketchValueError`` on
 invalid arguments; a campaign that cannot complete (unexpected
 exception escaping an un-faulted op) records the error in the verdict
@@ -99,6 +114,7 @@ __all__ = [
     "run_elastic_campaign",
     "run_adaptive_campaign",
     "run_windowed_campaign",
+    "run_fabric_campaign",
     "main",
 ]
 
@@ -2003,6 +2019,573 @@ def run_windowed_campaign(
             own_tmp.cleanup()
 
 
+# ---------------------------------------------------------------------------
+# Fabric campaign (the sharded-serve soak)
+# ---------------------------------------------------------------------------
+
+#: Fabric-campaign fleet shape: 4 virtual hosts, 3 copies per tenant --
+#: small enough for a CPU soak, big enough that every host kill leaves
+#: both a promotable verified replica and a survivor set to re-provision
+#: onto.
+_FB_HOSTS = 4
+_FB_REPLICATION = 3
+_FB_STREAMS = 4
+_FB_BINS = 128
+_FB_BATCH = 16
+_FB_QS = (0.5, 0.99)
+_FB_TENANTS = ("alpha", "beta", "gamma", "delta")
+_FB_STALENESS_S = 600.0
+
+
+def run_fabric_campaign(steps: int, seed: int) -> Dict[str, Any]:
+    """Run the seeded SHARDED-SERVE-FABRIC campaign -> the verdict.
+
+    A 4-host fabric serves 4 tenants at replication 3 under a virtual
+    clock while the campaign kills whole hosts mid-ingest (the primary's
+    tenants must re-home onto fingerprint-verified replicas with the
+    dropped mass itemized EXACTLY), partitions primaries (reads must
+    degrade to declared-staleness replicas, writes must refuse,
+    beyond-bound replicas must refuse loudly), silently corrupts replica
+    state (``fabric.replica_stale`` -- only the serve-time fingerprint
+    gate may catch it; a corrupt replica must NEVER serve), tears
+    partition heals (``mesh.partition_heal`` -- the host must stay
+    partitioned, never half-healed) and replica handoffs
+    (``reshard.torn`` -- the source replica must stay intact), and flips
+    the ``SKETCHES_TPU_FABRIC`` kill switch (which must refuse
+    construction loudly).
+
+    The accounting contract: every served answer is bit-identical to
+    the oracle fold of the mass it declares to cover (the live mirror
+    for primary reads, the canonical synced snapshot for replica
+    reads), and the per-stream mass ledger closes EXACTLY
+    (``expected + dropped == ingested``, ``==`` never approximately)
+    after every step AND every failover.  Anything else is
+    ``undetected`` and fails the run.
+
+    Under ``SKETCHES_TPU_FABRIC=0`` the campaign runs the disarmed
+    drill instead: every construction probe must refuse loudly
+    (``SpecError``) while single-process serving stays available; the
+    verdict carries ``disarmed: True``.  Raises ``SketchValueError``
+    for non-positive ``steps``; campaign-level failures land in the
+    verdict's ``errors`` list, never raised.
+    """
+    if steps <= 0:
+        raise SketchValueError("steps must be positive")
+    import os as _os
+
+    from sketches_tpu import serve
+    from sketches_tpu.analysis import registry as _registry
+    from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+    from sketches_tpu.fabric import FabricConfig, ServeFabric
+    from sketches_tpu.resilience import (
+        FabricUnavailable,
+        ReplicaStale,
+        SpecError,
+    )
+    from sketches_tpu.windows import VirtualClock
+
+    if not _registry.enabled(_registry.FABRIC):
+        # Disarmed lane: the switch must make every fabric construction
+        # refuse loudly -- and leave single-process serving untouched.
+        events: List[Dict[str, Any]] = []
+        errors: List[str] = []
+        for step in range(steps):
+            try:
+                ServeFabric(FabricConfig(n_hosts=2))
+                errors.append(
+                    f"step {step}: disarmed fabric constructed silently"
+                )
+                outcome = "undetected"
+            except SpecError:
+                outcome = "detected"
+            events.append(
+                {"step": step, "site": "fabric.kill_switch",
+                 "outcome": outcome}
+            )
+        try:
+            solo = serve.SketchServer()
+            solo.add_tenant("solo", 2, relative_accuracy=_REL_ACC)
+            solo.ingest("solo", np.ones((2, 4), np.float32))
+            solo.query("solo", (0.5,))
+        except Exception as e:  # noqa: BLE001 - any break is a finding
+            errors.append(f"disarmed single-process serving broke: {e!r}")
+        outcomes: Dict[str, int] = {}
+        for ev in events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        return {
+            "campaign": "fabric",
+            "steps": steps,
+            "seed": seed,
+            "disarmed": True,
+            "ok": not errors and outcomes.get("undetected", 0) == 0,
+            "n_faults": len(events),
+            "outcomes": outcomes,
+            "events": events[:16],  # one probe per step; keep it short
+            "errors": errors,
+            "health": resilience.health(),
+            "telemetry": telemetry.snapshot() if telemetry.enabled()
+            else None,
+        }
+
+    from sketches_tpu.backends.wirefmt import (
+        payload_from_bytes,
+        payload_to_bytes,
+    )
+
+    was_active, was_mode = integrity.enabled(), integrity.mode()
+    faults.disarm()
+    integrity.arm("quarantine")
+    rng = np.random.default_rng(seed)
+    clock = VirtualClock(0.0)
+    spec = SketchSpec(relative_accuracy=_REL_ACC, n_bins=_FB_BINS)
+    fab = ServeFabric(
+        FabricConfig(
+            n_hosts=_FB_HOSTS, replication=_FB_REPLICATION,
+            staleness_s=_FB_STALENESS_S,
+        ),
+        clock=clock,
+    )
+    # The oracle: a live mirror per tenant (fed bit-identical batches ->
+    # bit-identical primary state) plus the canonical synced snapshot
+    # per (tenant, replica host) -- exactly what the fabric's sync
+    # ledger promises each replica holds.
+    mirror: Dict[str, BatchedDDSketch] = {}
+    synced: Dict[str, Dict[int, Any]] = {}
+    expected: Dict[str, np.ndarray] = {}
+    dropped: Dict[str, np.ndarray] = {}
+    total_in: Dict[str, float] = {}
+    events = []
+    errors = []
+
+    def _canon(state):
+        # The wire seam's normalizing round trip: content-identical,
+        # canonical key window -- bit-identical to what a replica holds.
+        return payload_from_bytes(spec, payload_to_bytes(spec, state))
+
+    def _snap(nm: str):
+        return (_canon(mirror[nm].state), expected[nm].copy())
+
+    def _usable(h: int) -> bool:
+        return h in fab.live_hosts()
+
+    def _model_sync(nm: str, n_synced: int) -> None:
+        reps = [h for h in fab.placement(nm)[1:] if _usable(h)]
+        if n_synced != len(reps):
+            raise SketchError(
+                f"{nm}: fabric synced {n_synced} replicas, model expected"
+                f" {len(reps)}"
+            )
+        snap = _snap(nm)
+        for h in reps:
+            synced[nm][h] = snap
+
+    def _model_refresh(nm: str) -> None:
+        # Reconcile the model's replica set with the fabric's placement
+        # right after a verb that re-provisioned: a NEW replica was
+        # synced from the live primary at provision time.
+        reps = set(fab.placement(nm)[1:])
+        for h in list(synced[nm]):
+            if h not in reps:
+                del synced[nm][h]
+        fresh = [h for h in reps if h not in synced[nm] and _usable(h)]
+        if fresh:
+            snap = _snap(nm)
+            for h in fresh:
+                synced[nm][h] = snap
+
+    def _model_heal(host_id: int) -> None:
+        # heal_partition resynced every replica ON the healed host whose
+        # primary was reachable at plan time.
+        for nm in _FB_TENANTS:
+            pl = fab.placement(nm)
+            if host_id in pl[1:] and pl[0] != host_id:
+                synced[nm][host_id] = _snap(nm)
+
+    def _oracle_quantiles(state) -> np.ndarray:
+        return np.asarray(
+            BatchedDDSketch(
+                _FB_STREAMS, spec=spec, state=state
+            ).get_quantile_values(list(_FB_QS))
+        )
+
+    def _batch():
+        return rng.lognormal(
+            float(rng.normal(0.0, 0.5)), 0.7, (_FB_STREAMS, _FB_BATCH)
+        ).astype(np.float32)
+
+    def _pick() -> str:
+        return _FB_TENANTS[int(rng.integers(len(_FB_TENANTS)))]
+
+    for nm in _FB_TENANTS:
+        fab.add_tenant(nm, _FB_STREAMS, spec=spec)
+        mirror[nm] = BatchedDDSketch(_FB_STREAMS, spec=spec)
+        synced[nm] = {}
+        expected[nm] = np.zeros(_FB_STREAMS, np.float64)
+        dropped[nm] = np.zeros(_FB_STREAMS, np.float64)
+        total_in[nm] = 0.0
+        # Seed every tenant with mass and a sync point so replicas are
+        # promotable from step 0.
+        b = _batch()
+        fab.ingest(nm, b)
+        mirror[nm].add(b)
+        expected[nm] += float(_FB_BATCH)
+        total_in[nm] += float(_FB_STREAMS * _FB_BATCH)
+        _model_sync(nm, fab.sync(nm))
+
+    def _ingest(step: int) -> None:
+        clock.advance(float(rng.uniform(0.5, 4.0)))
+        nm = _pick()
+        b = _batch()
+        fab.ingest(nm, b)
+        mirror[nm].add(b)
+        expected[nm] += float(_FB_BATCH)
+        total_in[nm] += float(_FB_STREAMS * _FB_BATCH)
+
+    def _read(step: int) -> None:
+        nm = _pick()
+        res = fab.quantile(nm, _FB_QS)
+        if res.role not in ("primary", "cache") or res.hedged:
+            raise SketchError(
+                f"{nm}: healthy-fleet read served role={res.role}"
+                f" hedged={res.hedged}"
+            )
+        want = np.asarray(mirror[nm].get_quantile_values(list(_FB_QS)))
+        if not np.array_equal(
+            np.asarray(res.values), want, equal_nan=True
+        ):
+            raise SketchError(
+                f"{nm}: primary answer diverged from the live oracle"
+            )
+
+    def _sync(step: int) -> None:
+        nm = _pick()
+        _model_sync(nm, fab.sync(nm))
+
+    def _rebalance(step: int) -> None:
+        nm = _pick()
+        pl = fab.placement(nm)
+        free = [h for h in fab.live_hosts() if h not in pl]
+        srcs = [h for h in pl[1:] if _usable(h) and h in synced[nm]]
+        if not free or not srcs:
+            return
+        src = srcs[int(rng.integers(len(srcs)))]
+        dst = free[int(rng.integers(len(free)))]
+        fab.handoff_replica(nm, src, dst)
+        synced[nm][dst] = synced[nm].pop(src)
+
+    def _audit(step: int) -> None:
+        for nm in _FB_TENANTS:
+            led = fab.ledger(nm)
+            if not np.array_equal(led["expected_count"], expected[nm]):
+                raise SketchError(f"{nm}: expected_count ledger drifted")
+            if not np.array_equal(led["dropped_count"], dropped[nm]):
+                raise SketchError(f"{nm}: dropped_count ledger drifted")
+            if led["expected_total"] + led["dropped_total"] \
+                    != total_in[nm]:
+                raise SketchError(
+                    f"{nm}: mass not conserved:"
+                    f" {led['expected_total']} + {led['dropped_total']}"
+                    f" != {total_in[nm]}"
+                )
+            live = np.asarray(mirror[nm].state.count, np.float64)
+            if not np.array_equal(live, expected[nm]):
+                raise SketchError(f"{nm}: oracle mirror count drifted")
+
+    def _fault_host_kill(step: int) -> str:
+        live = fab.live_hosts()
+        if len(live) < 3:
+            return "skipped"
+        victim = int(live[int(rng.integers(len(live)))])
+        prims = sorted(
+            nm for nm in _FB_TENANTS if fab.placement(nm)[0] == victim
+        )
+        reports = fab.kill_host(victim)
+        if sorted(r.tenant for r in reports) != prims:
+            return "undetected"
+        for r in reports:
+            nm = r.tenant
+            snap = synced[nm].get(r.to_host)
+            if snap is None:
+                return "undetected"  # promoted a never-synced copy
+            state_syn, count_syn = snap
+            want_drop = expected[nm] - count_syn
+            if not r.exact or not np.array_equal(
+                r.dropped_count, want_drop
+            ):
+                return "undetected"  # the itemized dropped mass is wrong
+            dropped[nm] = dropped[nm] + want_drop
+            expected[nm] = count_syn.copy()
+            # The promoted replica IS the tenant now: the live oracle
+            # resets to the canonical synced snapshot.
+            mirror[nm] = BatchedDDSketch(
+                _FB_STREAMS, spec=spec, state=state_syn
+            )
+        for nm in _FB_TENANTS:
+            _model_refresh(nm)
+        for r in reports:
+            res = fab.quantile(r.tenant, _FB_QS)
+            want = np.asarray(
+                mirror[r.tenant].get_quantile_values(list(_FB_QS))
+            )
+            if not np.array_equal(
+                np.asarray(res.values), want, equal_nan=True
+            ):
+                return "undetected"  # wrong answer after failover
+        # A replacement process joins under the dead host's id; every
+        # under-replicated tenant re-provisions through the sync path.
+        fab.revive_host(victim)
+        for nm in _FB_TENANTS:
+            _model_refresh(nm)
+        return "re-homed"
+
+    def _fault_partition(step: int) -> str:
+        nm = _pick()
+        _model_sync(nm, fab.sync(nm))
+        p = fab.placement(nm)[0]
+        fab.partition_host(p)
+        ok = True
+        try:
+            res = fab.quantile(nm, _FB_QS)
+            if not (res.degraded and res.role == "replica"):
+                ok = False
+            else:
+                state_syn, _ = synced[nm][res.host]
+                if not np.array_equal(
+                    np.asarray(res.values),
+                    _oracle_quantiles(state_syn),
+                    equal_nan=True,
+                ):
+                    ok = False  # degraded answer != synced oracle fold
+            try:
+                fab.ingest(nm, _batch())
+                ok = False  # a partitioned primary must refuse writes
+            except FabricUnavailable:
+                pass
+            # Beyond the declared bound the replica must refuse loudly,
+            # never serve silently stale.
+            clock.advance(_FB_STALENESS_S + 1.0)
+            try:
+                fab.quantile(nm, _FB_QS)
+                ok = False
+            except ReplicaStale as e:
+                if e.reason != "staleness":
+                    ok = False
+        finally:
+            fab.heal_partition(p)
+        _model_heal(p)
+        res = fab.quantile(nm, _FB_QS)
+        want = np.asarray(mirror[nm].get_quantile_values(list(_FB_QS)))
+        if res.role not in ("primary", "cache") or not np.array_equal(
+            np.asarray(res.values), want, equal_nan=True
+        ):
+            ok = False
+        return "degraded" if ok else "undetected"
+
+    def _fault_replica_stale(step: int) -> str:
+        nm = _pick()
+        _model_sync(nm, fab.sync(nm))
+        p = fab.placement(nm)[0]
+        fab.partition_host(p)
+        before = fab.stats()["stale_refusals"]
+        # Fresh seed per firing: the corruption coordinates must roam,
+        # not re-flip the same bit of the same bin every time.
+        faults.arm(faults.FABRIC_REPLICA_STALE, times=1, seed=seed + step)
+        served = None
+        try:
+            try:
+                served = fab.quantile(nm, _FB_QS)
+            except ReplicaStale:
+                pass  # every reachable replica refused: loud is correct
+        finally:
+            faults.disarm()
+        refusals = fab.stats()["stale_refusals"] - before
+        if served is not None:
+            state_syn, _ = synced[nm][served.host]
+            right = np.array_equal(
+                np.asarray(served.values),
+                _oracle_quantiles(state_syn),
+                equal_nan=True,
+            )
+        else:
+            right = True  # refusing everywhere is never a wrong answer
+        fab.heal_partition(p)
+        _model_heal(p)
+        # Repair the corrupted copy through the sync path before the
+        # next step touches it.
+        _model_sync(nm, fab.sync(nm))
+        if not right:
+            return "undetected"  # a corrupt replica SERVED: booby trap failed
+        if refusals == 0:
+            # The flip landed invisibly to the content fingerprint (the
+            # sign bit of a zero count): the served answer was proven
+            # bit-identical above, so the corruption is harmless.
+            return "harmless" if served is not None else "undetected"
+        return "detected"
+
+    def _fault_heal_torn(step: int) -> str:
+        live = fab.live_hosts()
+        if len(live) < 2:
+            return "skipped"
+        h = int(live[int(rng.integers(len(live)))])
+        fab.partition_host(h)
+        faults.arm(faults.MESH_PARTITION_HEAL, times=1)
+        try:
+            fab.heal_partition(h)
+            torn = False
+        except InjectedFault:
+            torn = True
+        finally:
+            faults.disarm()
+        if not torn:
+            return "undetected"  # the armed tear never surfaced
+        if h in fab.live_hosts():
+            return "undetected"  # a torn heal half-committed
+        fab.heal_partition(h)
+        _model_heal(h)
+        return "detected"
+
+    def _fault_handoff_torn(step: int) -> str:
+        nm = _pick()
+        pl = fab.placement(nm)
+        free = [h for h in fab.live_hosts() if h not in pl]
+        srcs = [h for h in pl[1:] if _usable(h) and h in synced[nm]]
+        if not free or not srcs:
+            return "skipped"
+        src = srcs[int(rng.integers(len(srcs)))]
+        dst = free[int(rng.integers(len(free)))]
+        faults.arm(faults.RESHARD_TORN, times=1)
+        try:
+            fab.handoff_replica(nm, src, dst)
+            torn = False
+        except InjectedFault:
+            torn = True
+        except SpecError:
+            return "skipped"  # source had no ledger to move
+        finally:
+            faults.disarm()
+        if not torn:
+            return "undetected"
+        if fab.placement(nm) != pl:
+            return "undetected"  # the torn handoff moved the replica
+        # The interrupted handoff must complete cleanly afterwards,
+        # carrying the fingerprint (and the cache keyed on it) along.
+        rep = fab.handoff_replica(nm, src, dst)
+        synced[nm][dst] = synced[nm].pop(src)
+        if not rep.cache_preserved:
+            return "undetected"
+        return "detected"
+
+    def _fault_kill_switch(step: int) -> str:
+        _switch = _registry.FABRIC.name
+        prior = _os.environ.get(_switch)
+        _os.environ[_switch] = "0"
+        try:
+            try:
+                ServeFabric(FabricConfig(n_hosts=2))
+                return "undetected"
+            except SpecError:
+                pass
+        finally:
+            if prior is None:
+                _os.environ.pop(_switch, None)
+            else:
+                _os.environ[_switch] = prior
+        # The switch gates construction, not the running fleet: the
+        # armed fabric must still answer correctly.
+        nm = _pick()
+        res = fab.quantile(nm, _FB_QS)
+        want = np.asarray(mirror[nm].get_quantile_values(list(_FB_QS)))
+        return (
+            "detected"
+            if np.array_equal(np.asarray(res.values), want, equal_nan=True)
+            else "undetected"
+        )
+
+    ops = (
+        (_ingest, 0.4),
+        (_read, 0.3),
+        (_sync, 0.2),
+        (_rebalance, 0.1),
+    )
+    op_fns = [o[0] for o in ops]
+    op_ps = np.asarray([o[1] for o in ops])
+    op_ps = op_ps / op_ps.sum()
+    fault_sites = {
+        "mesh.host_loss": _fault_host_kill,
+        "dcn.partition": _fault_partition,
+        "fabric.replica_stale": _fault_replica_stale,
+        "mesh.partition_heal": _fault_heal_torn,
+        "reshard.torn": _fault_handoff_torn,
+        "fabric.kill_switch": _fault_kill_switch,
+    }
+    site_names = tuple(fault_sites)
+    try:
+        for step in range(steps):
+            op = int(rng.choice(len(op_fns), p=op_ps))
+            try:
+                op_fns[op](step)
+            except Exception as e:  # un-faulted op must not fail
+                errors.append(f"step {step} op {op}: {e!r}")
+                break
+            if rng.random() < _FAULT_P:
+                site = site_names[int(rng.integers(len(site_names)))]
+                try:
+                    outcome = fault_sites[site](step)
+                except Exception as e:
+                    outcome = "undetected"
+                    errors.append(f"step {step} site {site}: {e!r}")
+                if outcome != "skipped":
+                    events.append(
+                        {"step": step, "site": site, "outcome": outcome}
+                    )
+                    _classify_forensics(site, outcome, step)
+            # The acceptance contract: the mass ledger closes exactly at
+            # EVERY step, not just at the end.
+            try:
+                _audit(step)
+            except SketchError as e:
+                errors.append(f"step {step} audit: {e!r}")
+                break
+        outcomes = {}
+        for ev in events:
+            outcomes[ev["outcome"]] = outcomes.get(ev["outcome"], 0) + 1
+        ok = not errors and outcomes.get("undetected", 0) == 0
+        ledgers = {}
+        for nm in _FB_TENANTS:
+            led = fab.ledger(nm)
+            ledgers[nm] = {
+                "expected_total": led["expected_total"],
+                "dropped_total": led["dropped_total"],
+                "ingested_total": total_in[nm],
+                "hosts": list(led["hosts"]),
+                "fingerprint": led.get("fingerprint"),
+            }
+        return {
+            "campaign": "fabric",
+            "steps": steps,
+            "seed": seed,
+            "disarmed": False,
+            "ok": ok,
+            "n_faults": len(events),
+            "outcomes": outcomes,
+            "events": events,
+            "errors": errors,
+            "virtual_clock_s": clock.t,
+            "ledgers": ledgers,
+            "fabric_stats": fab.stats(),
+            "integrity_reports": len(integrity.reports()),
+            "health": resilience.health(),
+            "telemetry": telemetry.snapshot() if telemetry.enabled()
+            else None,
+        }
+    finally:
+        faults.disarm()
+        if was_active:
+            integrity.arm(was_mode)
+        else:
+            integrity.disarm()
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI entry point: run the campaign, write the verdict, exit 0 iff
     every injected fault was accounted for (1 otherwise).
@@ -2023,7 +2606,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
         "--campaign",
-        choices=("core", "serve", "elastic", "adaptive", "windowed"),
+        choices=("core", "serve", "elastic", "adaptive", "windowed", "fabric"),
         default="core",
         help="core: the integrity soak over the storage/engine sites;"
         " serve: the serving-tier soak over the serve.* sites (every"
@@ -2039,7 +2622,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         " checkpoints, wire corruption, reshard-during-rotation, serve"
         " cache poison, kill-switch refusal -- window queries"
         " bit-identical to the oracle merge, per-bucket mass ledger"
-        " exact at every step)",
+        " exact at every step); fabric: the sharded-serve soak (host"
+        " kills with fingerprint-verified failover and exact"
+        " dropped-mass itemization, primary partitions degrading to"
+        " declared-staleness replica reads, silent replica corruption"
+        " that must never serve, torn heals and handoffs, kill-switch"
+        " refusal -- every answer bit-identical to its oracle fold)",
     )
     parser.add_argument(
         "--mode", choices=("raise", "quarantine"), default="raise",
@@ -2072,6 +2660,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         verdict = run_adaptive_campaign(args.steps, args.seed)
     elif args.campaign == "windowed":
         verdict = run_windowed_campaign(args.steps, args.seed)
+    elif args.campaign == "fabric":
+        verdict = run_fabric_campaign(args.steps, args.seed)
     else:
         verdict = run_campaign(args.steps, args.seed, mode=args.mode)
     if args.out:

@@ -64,6 +64,16 @@ site                    simulates
                         :func:`agg_stale_flips` (returns flip coordinates
                         rather than raising; the stack-consistency
                         integrity audit's adversary)
+``mesh.partition_heal``  a partition heal interrupted between replica
+                        reconciliation and the un-partition commit
+                        (raises at the fabric heal seam; the host must
+                        stay partitioned -- degraded but consistent --
+                        never half-healed)
+``fabric.replica_stale``  silent corruption of a synced read replica --
+                        consumed by the serve fabric via
+                        :func:`replica_stale_flips` (returns flip
+                        coordinates rather than raising; the
+                        fingerprint-verified replica read's adversary)
 ======================  ====================================================
 
 Arming: programmatically via :func:`arm` / :func:`active` (tests), or at
@@ -111,6 +121,8 @@ __all__ = [
     "WINDOW_ROTATE_TORN",
     "WINDOW_STACK_TORN",
     "WINDOW_AGG_STALE",
+    "MESH_PARTITION_HEAL",
+    "FABRIC_REPLICA_STALE",
     "SITES",
     "arm",
     "disarm",
@@ -123,6 +135,7 @@ __all__ = [
     "apply_state_bitflips",
     "cache_poison_flip",
     "agg_stale_flips",
+    "replica_stale_flips",
     "stats",
     "corrupt_blobs",
 ]
@@ -148,6 +161,8 @@ SERVE_QUEUE_OVERFLOW = "serve.queue_overflow"
 WINDOW_ROTATE_TORN = "window.rotate_torn"
 WINDOW_STACK_TORN = "window.stack_torn"
 WINDOW_AGG_STALE = "window.agg_stale"
+MESH_PARTITION_HEAL = "mesh.partition_heal"
+FABRIC_REPLICA_STALE = "fabric.replica_stale"
 
 SITES = (
     NATIVE_LOAD,
@@ -167,6 +182,8 @@ SITES = (
     WINDOW_ROTATE_TORN,
     WINDOW_STACK_TORN,
     WINDOW_AGG_STALE,
+    MESH_PARTITION_HEAL,
+    FABRIC_REPLICA_STALE,
 )
 
 #: Fast-path guard: seams check this module flag before calling
@@ -463,6 +480,50 @@ def agg_stale_flips(
     if tracing._ACTIVE:
         tracing.record_event(
             "fault.injected", site=WINDOW_AGG_STALE,
+            coords=str((store, stream, bin_, bit)),
+        )
+    return ((store, stream, bin_, bit),)
+
+
+def replica_stale_flips(
+    n_streams: int, n_bins: int
+) -> Tuple[Tuple[int, int, int, int], ...]:
+    """Armed read-replica corruption coordinates -- the
+    ``fabric.replica_stale`` site's consumer-side read (it returns data
+    rather than raising, like :func:`state_bitflips`).
+
+    Same coordinate scheme as :func:`state_bitflips` -- each firing
+    yields one ``(store, stream, bin, bit)`` tuple derived
+    deterministically from the plan's seed and its running call count --
+    but aimed at a serve-fabric READ REPLICA after its sync: the
+    primary stays clean, so only the fingerprint-vs-ledger verification
+    at serve time can tell the replica went stale-wrong.  The flipped
+    bit is drawn from the magnitude-bearing float32 bits (top mantissa,
+    high exponent) so the corruption is material whenever the bin
+    carries mass -- and the high-exponent pick is material even on an
+    empty bin; a uniformly random low bit would vanish into the
+    fingerprint sum's rounding and drill nothing.  Disarmed (the
+    default) it returns ``()`` after one bool test.  Respects the
+    plan's ``times`` cap.
+    """
+    if not _ACTIVE:
+        return ()
+    plan = _plans.get(FABRIC_REPLICA_STALE)
+    if plan is None:
+        return ()
+    plan.calls += 1
+    if plan.times is not None and plan.fired >= plan.times:
+        return ()
+    h = binascii.crc32(f"{plan.seed}:{plan.calls}".encode()) & 0xFFFFFFFF
+    store = h & 1
+    stream = (h >> 1) % max(n_streams, 1)
+    bin_ = (h >> 11) % max(n_bins, 1)
+    bit = (21, 22, 30)[(h >> 25) % 3]
+    plan.fired += 1
+    bump("faults." + FABRIC_REPLICA_STALE)
+    if tracing._ACTIVE:
+        tracing.record_event(
+            "fault.injected", site=FABRIC_REPLICA_STALE,
             coords=str((store, stream, bin_, bit)),
         )
     return ((store, stream, bin_, bit),)

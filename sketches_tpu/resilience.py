@@ -59,6 +59,8 @@ __all__ = [
     "InjectedFault",
     "ServeOverload",
     "DeadlineExceeded",
+    "ReplicaStale",
+    "FabricUnavailable",
     "QuarantineRecord",
     "QuarantineReport",
     "ShardLossReport",
@@ -173,6 +175,30 @@ class DeadlineExceeded(SketchError):
     dispatch could answer it (``sketches_tpu.serve``).  Raised at
     admission/flush time -- a request near (but not past) its deadline
     degrades to the cheapest engine tier instead of raising."""
+
+
+class ReplicaStale(SketchError):
+    """A serve-fabric read replica refused to answer
+    (``sketches_tpu.fabric``): its content fingerprint no longer
+    matches the primary's ledgered state (stale-WRONG -- the
+    booby-trap), or its sync lag exceeds the tenant's declared
+    staleness bound (stale-beyond-contract).  Refusal is loud and the
+    read re-homes; a mismatched replica never serves.  ``reason`` is
+    the stable refusal class (``fingerprint`` / ``staleness``)."""
+
+    def __init__(self, message: str, reason: str = "", tenant: str = ""):
+        super().__init__(message)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class FabricUnavailable(SketchError):
+    """No serveable copy of a fabric tenant remains
+    (``sketches_tpu.fabric``): the primary host is dead or partitioned
+    and every replica either refused (:class:`ReplicaStale`) or lives
+    on a dead/partitioned host.  Raised instead of serving a wrong or
+    out-of-contract answer -- unavailability is declared, never
+    improvised around."""
 
 
 # ---------------------------------------------------------------------------

@@ -377,6 +377,22 @@ _DECLARED = (
            " maintained path (component chain + suffix/back-tail"
            " combine) -- O(1) per query, vs O(covered buckets) with"
            " SKETCHES_TPU_WINDOW_AGG=0."),
+    Metric("fabric.replica_syncs", "counter", "sketches_tpu.fabric",
+           "Replica refreshes shipped over the wire seam: a replica's"
+           " state replaced by a fold of the primary's, fingerprint"
+           " ledgered at the sync point."),
+    Metric("fabric.failovers", "counter", "sketches_tpu.fabric",
+           "Tenant re-homings after a host loss: a surviving replica"
+           " promoted to primary with the dropped mass itemized in the"
+           " fabric's ledger."),
+    Metric("fabric.hedge_cross_host", "counter", "sketches_tpu.fabric",
+           "Cross-host hedge dispatches: a primary read that failed or"
+           " straggled was re-issued against a fingerprint-verified"
+           " replica on another host."),
+    Metric("fabric.staleness_s", "histogram", "sketches_tpu.fabric",
+           "Replica staleness observed at serve time (serving-clock"
+           " seconds since the replica's ledgered sync), recorded per"
+           " replica-served read (label: tenant)."),
 )
 
 #: Every declared metric by name (static inventory + runtime
@@ -1794,6 +1810,13 @@ BENCH_GATE: Tuple[Tuple[str, str, float], ...] = (
     # host-timed fused dispatches, so they breathe like the serde rows.
     ("configs.windowed.window_query_p50_s", "lower", 0.40),
     ("configs.windowed.window_query_vs_single_floorsub", "lower", 0.40),
+    # Serve fabric (r21): host-timed fabric reads + failover blackout --
+    # small host-clock numbers, so they get the serde-class tolerance.
+    ("configs.serve_fabric.qps_vs_hosts.h4.warm_cache_qps",
+     "higher", 0.40),
+    ("configs.serve_fabric.qps_vs_hosts.h4.uncached_query_p50_s",
+     "lower", 0.40),
+    ("configs.serve_fabric.failover.blackout_s", "lower", 0.60),
 )
 
 

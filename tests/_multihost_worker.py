@@ -22,6 +22,13 @@ psum-merge chain folds ICI first then DCN, and each worker checkpoints
 its PROCESS-LOCAL merged partial to ``snapshot_dir/partial<pid>.npz`` —
 the per-host artifacts the parent folds with ``parallel.fold_hosts``
 and resumes onto a different mesh size (the elastic DCN protocol).
+
+``mode="fabric"`` runs the sharded-serve-fabric drill: every process
+replays the same deterministic fabric op log (ingest, replica sync,
+primary kill mid-ingest, failover) and the job all-gathers the
+promoted fingerprints and served answers across the DCN boundary --
+fingerprint-verified convergence; per-process verdicts land in
+``snapshot_dir/fabric<pid>.json`` for the parent's cross-check.
 """
 import os
 import sys
@@ -101,6 +108,86 @@ def elastic_main(pid: int, nproc: int, snapshot_dir: str) -> None:
     )
 
 
+def fabric_main(pid: int, nproc: int, snapshot_dir: str) -> None:
+    """The sharded-serve-fabric drill (mode="fabric"): every process
+    drives an IDENTICAL ServeFabric through the same deterministic op
+    log -- ingest, replica sync, a primary kill mid-ingest, failover --
+    and the job verifies FINGERPRINT CONVERGENCE across the process
+    (DCN-analog) boundary with an all-gather: the placement function
+    and the op log are both deterministic, so every process must ledger
+    the same promoted fingerprint, itemize the same dropped mass
+    exactly, and serve bit-identical post-failover answers."""
+    import json
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from sketches_tpu.batched import SketchSpec
+    from sketches_tpu.fabric import FabricConfig, ServeFabric
+    from sketches_tpu.windows import VirtualClock
+
+    n_streams, chunk = 4, 32
+    spec = SketchSpec(relative_accuracy=0.02, n_bins=128)
+    fab = ServeFabric(
+        FabricConfig(n_hosts=4, replication=3, staleness_s=600.0),
+        clock=VirtualClock(0.0),
+    )
+    fab.add_tenant("t", n_streams, spec=spec)
+    rng = np.random.default_rng(17)  # the SAME stream on every process
+    for _ in range(3):
+        fab.ingest(
+            "t", rng.lognormal(0.0, 0.7, (n_streams, chunk)).astype(np.float32)
+        )
+    assert fab.sync("t") == 2
+    # Mid-ingest mass past the sync point: exactly what the failover
+    # must itemize as dropped.
+    fab.ingest(
+        "t", rng.lognormal(0.0, 0.7, (n_streams, chunk)).astype(np.float32)
+    )
+    primary = fab.placement("t")[0]
+    reports = fab.kill_host(primary)
+    assert len(reports) == 1 and reports[0].tenant == "t"
+    assert reports[0].exact
+    assert np.array_equal(
+        reports[0].dropped_count, np.full(n_streams, float(chunk))
+    )
+    led = fab.ledger("t")
+    assert led["expected_total"] + led["dropped_total"] \
+        == 4.0 * n_streams * chunk
+    res = fab.quantile("t", (0.5, 0.99))
+    assert res.role in ("primary", "cache")
+
+    # Fingerprint-verified convergence across the DCN boundary: the
+    # promoted state's ledgered fingerprint and the served answers must
+    # be bit-identical on every process.
+    fp = np.frombuffer(bytes.fromhex(led["fingerprint"]), np.uint8)
+    gathered = multihost_utils.process_allgather(fp)
+    assert gathered.shape == (nproc, fp.size) and (
+        gathered == gathered[0]
+    ).all(), "fabric fingerprints diverged across processes"
+    vals = np.asarray(res.values, np.float64)
+    gvals = multihost_utils.process_allgather(vals)
+    assert (gvals == gvals[0]).all(), \
+        "post-failover answers diverged across processes"
+
+    with open(
+        os.path.join(snapshot_dir, f"fabric{pid}.json"), "w",
+        encoding="utf-8",
+    ) as f:
+        json.dump(
+            {
+                "fingerprint": led["fingerprint"],
+                "from_host": reports[0].from_host,
+                "to_host": reports[0].to_host,
+                "dropped_total": float(reports[0].dropped_total),
+                "expected_total": led["expected_total"],
+                "values": vals.tolist(),
+            },
+            f, indent=1, sort_keys=True,
+        )
+        f.write("\n")
+
+
 def main() -> None:
     port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     snapshot_dir = sys.argv[4] if len(sys.argv) > 4 else None
@@ -130,6 +217,12 @@ def main() -> None:
 
     if mode == "elastic":
         elastic_main(pid, nproc, snapshot_dir)
+        jax.distributed.shutdown()
+        print(f"MULTIHOST_OK pid={pid}")
+        return
+
+    if mode == "fabric":
+        fabric_main(pid, nproc, snapshot_dir)
         jax.distributed.shutdown()
         print(f"MULTIHOST_OK pid={pid}")
         return

@@ -1,0 +1,453 @@
+"""Sharded serve fabric: placement, fingerprint-verified replica sync,
+failover with exact dropped-mass accounting, partitions, handoffs.
+
+The contracts under test (DESIGN.md section 20):
+
+* Placement is a pure rendezvous ranking: deterministic, and removing a
+  host re-ranks only that host's tenants (minimal movement).
+* A replica serves ONLY while its live fingerprint bit-matches its
+  ledgered sync digest (the booby trap: silent corruption never
+  serves, and is never promoted at failover).
+* Failover closes the mass ledger exactly:
+  ``dropped == expected - promoted_replica_synced`` per stream, and
+  ``expected + dropped == ingested`` always.
+* Partitions degrade reads to declared-staleness replicas; beyond the
+  bound the replica refuses loudly; writes refuse rather than fork.
+* Torn heals and torn handoffs are atomic (partitioned-but-consistent,
+  source-intact respectively).
+* ``SKETCHES_TPU_FABRIC=0`` refuses construction loudly.
+"""
+
+import numpy as np
+import pytest
+
+from sketches_tpu import faults, fabric
+from sketches_tpu.analysis import registry
+from sketches_tpu.batched import BatchedDDSketch, SketchSpec
+from sketches_tpu.fabric import FabricConfig, ServeFabric, placement
+from sketches_tpu.resilience import (
+    FabricUnavailable,
+    InjectedFault,
+    ReplicaStale,
+    SketchValueError,
+    SpecError,
+)
+from sketches_tpu.windows import VirtualClock
+
+SPEC = SketchSpec(relative_accuracy=0.02, n_bins=128)
+QS = (0.5, 0.99)
+
+# Loud-refusal parity (the CI SKETCHES_TPU_FABRIC=0 lane): functional
+# tests skip, the refusal/registry/campaign tests still run and pass.
+_ARMED = registry.enabled(registry.FABRIC)
+needs_fabric = pytest.mark.skipif(
+    not _ARMED, reason="SKETCHES_TPU_FABRIC=0 (loud-refusal lane)"
+)
+
+
+def _batch(seed=0, n_streams=4, n=16):
+    rng = np.random.default_rng(seed)
+    return rng.lognormal(0.0, 0.7, (n_streams, n)).astype(np.float32)
+
+
+def _fleet(n_hosts=4, replication=3, staleness_s=600.0, clock=None):
+    return ServeFabric(
+        FabricConfig(
+            n_hosts=n_hosts, replication=replication,
+            staleness_s=staleness_s,
+        ),
+        clock=clock or VirtualClock(0.0),
+    )
+
+
+def _corrupt(fab, name, host):
+    """Silently flip a material bit in the replica's stored state --
+    no version bump, no announcement."""
+    facade = fab.host_server(host).tenant(name)
+    facade.state = faults.apply_state_bitflips(
+        facade.state, ((0, 0, 40, 30),)
+    )
+
+
+class TestPlacement:
+    def test_deterministic_and_distinct(self):
+        for name in ("a", "b", "tenant-17"):
+            pl = placement(name, 8, 3)
+            assert pl == placement(name, 8, 3)
+            assert len(pl) == 3 and len(set(pl)) == 3
+
+    def test_replication_clipped_to_fleet(self):
+        assert len(placement("a", 2, 5)) == 2
+
+    def test_minimal_movement_on_host_loss(self):
+        """Removing a host preserves the survivors' relative ranking:
+        only the lost host's tenants move."""
+        for name in ("a", "b", "c", "d", "e"):
+            full = placement(name, 6, 6)
+            for victim in range(6):
+                survivors = tuple(h for h in full if h != victim)
+                ranked = sorted(
+                    (h for h in range(6) if h != victim),
+                    key=lambda h: (
+                        -fabric._rendezvous_score(name, h), h,
+                    ),
+                )
+                assert survivors == tuple(ranked)
+
+    def test_invalid_args_refused(self):
+        with pytest.raises(SketchValueError):
+            placement("a", 0, 1)
+        with pytest.raises(SketchValueError):
+            placement("a", 4, 0)
+
+
+class TestKillSwitch:
+    def test_disarmed_construction_refuses_loudly(self, monkeypatch):
+        monkeypatch.setenv(registry.FABRIC.name, "0")
+        with pytest.raises(SpecError, match="SKETCHES_TPU_FABRIC"):
+            ServeFabric(FabricConfig(n_hosts=2))
+
+    def test_registry_row(self):
+        v = registry.lookup("SKETCHES_TPU_FABRIC")
+        assert v is registry.FABRIC
+        assert v.owner == "sketches_tpu.fabric"
+
+
+@needs_fabric
+class TestTenancy:
+    def test_add_tenant_places_and_replicates(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        pl = fab.placement("t")
+        assert pl == placement("t", 4, 3)
+        assert fab.stats()["replica_syncs"] == 2  # both replicas synced
+
+    def test_reregister_refused(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        with pytest.raises(SpecError, match="already registered"):
+            fab.add_tenant("t", 4, spec=SPEC)
+
+    def test_windowed_and_mesh_tenants_refused(self):
+        fab = _fleet()
+        with pytest.raises(SpecError, match="dense folds"):
+            fab.add_tenant("w", 4, window=True, spec=SPEC)
+        with pytest.raises(SpecError, match="dense folds"):
+            fab.add_tenant("m", 4, mesh=object(), spec=SPEC)
+
+
+@needs_fabric
+class TestSyncAndLedger:
+    def test_ingest_tracks_exact_mass(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(1))
+        fab.ingest("t", _batch(2))
+        led = fab.ledger("t")
+        assert np.array_equal(led["expected_count"], np.full(4, 32.0))
+        assert led["dropped_total"] == 0.0
+
+    def test_nonfinite_mass_not_ledgered(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        b = _batch(3)
+        b[0, 0] = np.nan
+        b[1, 0] = np.inf
+        fab.ingest("t", b)
+        led = fab.ledger("t")
+        assert led["expected_count"].tolist() == [15.0, 15.0, 16.0, 16.0]
+
+    def test_replica_answers_bit_identical_after_sync(self):
+        clock = VirtualClock(0.0)
+        fab = _fleet(clock=clock)
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(4))
+        primary_answer = np.asarray(fab.quantile("t", QS).values)
+        assert fab.sync("t") == 2
+        fab.partition_host(fab.placement("t")[0])
+        res = fab.quantile("t", QS)
+        assert res.role == "replica" and res.degraded
+        assert np.array_equal(
+            np.asarray(res.values), primary_answer, equal_nan=True
+        )
+
+
+@needs_fabric
+class TestFailover:
+    def test_exact_dropped_mass_and_convergence(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(5))
+        assert fab.sync("t") == 2
+        fab.ingest("t", _batch(6))  # post-sync mass: dropped at failover
+        primary = fab.placement("t")[0]
+        reports = fab.kill_host(primary)
+        assert len(reports) == 1
+        r = reports[0]
+        assert r.tenant == "t" and r.from_host == primary
+        assert r.exact
+        assert np.array_equal(r.dropped_count, np.full(4, 16.0))
+        led = fab.ledger("t")
+        assert np.array_equal(led["expected_count"], np.full(4, 16.0))
+        assert np.array_equal(led["dropped_count"], np.full(4, 16.0))
+        # The promoted replica answers exactly its synced content.
+        res = fab.quantile("t", QS)
+        assert res.role in ("primary", "cache")
+
+    def test_failover_restores_replication(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(7))
+        fab.sync("t")
+        fab.kill_host(fab.placement("t")[0])
+        assert len(fab.placement("t")) == 3  # re-provisioned on survivors
+        assert fab.stats()["failovers"] == 1
+
+    def test_corrupted_replica_never_promoted(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(8))
+        fab.sync("t")
+        pl = fab.placement("t")
+        _corrupt(fab, "t", pl[1])  # first-ranked replica goes stale-wrong
+        reports = fab.kill_host(pl[0])
+        r = reports[0]
+        assert pl[1] in r.refused_replicas
+        assert r.to_host == pl[2]
+
+    def test_no_verified_replica_is_unavailable(self):
+        fab = _fleet(n_hosts=3, replication=2)
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(9))
+        fab.sync("t")
+        pl = fab.placement("t")
+        for h in pl[1:]:
+            _corrupt(fab, "t", h)
+        with pytest.raises(FabricUnavailable, match="no"):
+            fab.kill_host(pl[0])
+
+    def test_revive_host_reprovisions(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(10))
+        fab.sync("t")
+        victim = fab.placement("t")[0]
+        fab.kill_host(victim)
+        assert victim not in fab.live_hosts()
+        assert fab.revive_host(victim) >= 0
+        assert victim in fab.live_hosts()
+        # A revived host never serves leftover state: only a fresh
+        # fingerprint-verified sync can give it a ledger.
+        fab.sync()
+        res = fab.quantile("t", QS)
+        assert res.role in ("primary", "cache")
+
+
+@needs_fabric
+class TestBoobyTrap:
+    """The acceptance criterion: a replica whose live fingerprint does
+    not bit-match its ledgered sync digest NEVER serves."""
+
+    def test_corrupt_replica_refuses_and_rehomes(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(11))
+        fab.sync("t")
+        want = np.asarray(fab.quantile("t", QS).values)
+        pl = fab.placement("t")
+        _corrupt(fab, "t", pl[1])
+        fab.partition_host(pl[0])
+        res = fab.quantile("t", QS)
+        assert res.role == "replica" and res.host == pl[2]
+        assert np.array_equal(np.asarray(res.values), want, equal_nan=True)
+        assert fab.stats()["stale_refusals"] == 1
+
+    def test_all_replicas_corrupt_raises_loudly(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(12))
+        fab.sync("t")
+        pl = fab.placement("t")
+        for h in pl[1:]:
+            _corrupt(fab, "t", h)
+        fab.partition_host(pl[0])
+        with pytest.raises(ReplicaStale) as exc:
+            fab.quantile("t", QS)
+        assert exc.value.reason == "fingerprint"
+
+    def test_heal_repairs_corrupt_replica(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(13))
+        fab.sync("t")
+        pl = fab.placement("t")
+        _corrupt(fab, "t", pl[1])
+        # The sync path replaces the corrupt state wholesale and
+        # re-ledgers; the replica serves again.
+        assert fab.sync("t") == 2
+        fab.partition_host(pl[0])
+        assert fab.quantile("t", QS).role == "replica"
+
+
+@needs_fabric
+class TestPartitions:
+    def test_partitioned_primary_degrades_reads_refuses_writes(self):
+        clock = VirtualClock(0.0)
+        fab = _fleet(clock=clock)
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(14))
+        fab.sync("t")
+        p = fab.placement("t")[0]
+        fab.partition_host(p)
+        res = fab.quantile("t", QS)
+        assert res.degraded and res.role == "replica"
+        with pytest.raises(FabricUnavailable, match="fork"):
+            fab.ingest("t", _batch(15))
+
+    def test_beyond_bound_replica_refuses(self):
+        clock = VirtualClock(0.0)
+        fab = _fleet(staleness_s=30.0, clock=clock)
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(16))
+        fab.sync("t")
+        fab.partition_host(fab.placement("t")[0])
+        clock.advance(31.0)
+        with pytest.raises(ReplicaStale) as exc:
+            fab.quantile("t", QS)
+        assert exc.value.reason == "staleness"
+
+    def test_heal_reconciles_and_restores_primary(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(17))
+        fab.sync("t")
+        want = np.asarray(fab.quantile("t", QS).values)
+        p = fab.placement("t")[0]
+        fab.partition_host(p)
+        fab.quantile("t", QS)
+        fab.heal_partition(p)
+        res = fab.quantile("t", QS)
+        assert res.role in ("primary", "cache")
+        assert np.array_equal(np.asarray(res.values), want, equal_nan=True)
+
+    def test_torn_heal_is_atomic(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(18))
+        fab.sync("t")
+        h = fab.placement("t")[1]
+        fab.partition_host(h)
+        faults.arm(faults.MESH_PARTITION_HEAL, times=1)
+        try:
+            with pytest.raises(InjectedFault):
+                fab.heal_partition(h)
+        finally:
+            faults.disarm()
+        assert h not in fab.live_hosts()  # still partitioned, not torn
+        assert fab.heal_partition(h) == 1  # the retry completes
+
+
+@needs_fabric
+class TestHandoff:
+    def _warm_fleet(self):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(19))
+        fab.sync("t")
+        return fab
+
+    def test_clean_handoff_moves_replica_and_ledger(self):
+        fab = self._warm_fleet()
+        pl = fab.placement("t")
+        free = next(h for h in fab.live_hosts() if h not in pl)
+        rep = fab.handoff_replica("t", pl[1], free)
+        assert rep.cache_preserved
+        assert free in fab.placement("t")
+        assert pl[1] not in fab.placement("t")
+        # The moved replica serves, fingerprint-verified.
+        fab.partition_host(fab.placement("t")[0])
+        assert fab.quantile("t", QS).role == "replica"
+
+    def test_cache_survives_handoff(self):
+        """Fingerprints are topology-free: the fabric cache entry keyed
+        on the replica's digest survives the move."""
+        fab = self._warm_fleet()
+        pl = fab.placement("t")
+        # Warm the fabric cache through a degraded replica read.
+        fab.partition_host(pl[0])
+        fab.quantile("t", QS)
+        fab.heal_partition(pl[0])
+        moved_from = fab.placement("t")[1]
+        free = next(h for h in fab.live_hosts() if h not in fab.placement("t"))
+        fab.handoff_replica("t", moved_from, free)
+        before = fab.stats()["cache_hits"]
+        fab.partition_host(fab.placement("t")[0])
+        res = fab.quantile("t", QS)
+        assert res.tier == "cache"
+        assert fab.stats()["cache_hits"] == before + 1
+
+    def test_torn_handoff_leaves_source_intact(self):
+        fab = self._warm_fleet()
+        pl = fab.placement("t")
+        free = next(h for h in fab.live_hosts() if h not in pl)
+        faults.arm(faults.RESHARD_TORN, times=1)
+        try:
+            with pytest.raises(InjectedFault):
+                fab.handoff_replica("t", pl[1], free)
+        finally:
+            faults.disarm()
+        assert fab.placement("t") == pl  # nothing moved
+        # The source replica still serves.
+        fab.partition_host(pl[0])
+        assert fab.quantile("t", QS).role == "replica"
+
+    def test_handoff_validations(self):
+        fab = self._warm_fleet()
+        pl = fab.placement("t")
+        free = next(h for h in fab.live_hosts() if h not in pl)
+        with pytest.raises(SpecError, match="holds no replica"):
+            fab.handoff_replica("t", free, pl[1])
+        with pytest.raises(SpecError, match="already holds"):
+            fab.handoff_replica("t", pl[1], pl[2])
+
+
+@needs_fabric
+class TestHedge:
+    def test_primary_engine_failure_hedges_cross_host(self, monkeypatch):
+        fab = _fleet()
+        fab.add_tenant("t", 4, spec=SPEC)
+        fab.ingest("t", _batch(20))
+        fab.sync("t")
+        want = np.asarray(fab.quantile("t", QS).values)
+        primary = fab.placement("t")[0]
+
+        def _boom(*a, **k):
+            raise RuntimeError("primary engine ladder down")
+
+        monkeypatch.setattr(
+            fab.host_server(primary), "query", _boom
+        )
+        fab._cache.clear()
+        fab._cache_order.clear()
+        res = fab.quantile("t", QS)
+        assert res.hedged and res.role == "replica"
+        assert np.array_equal(np.asarray(res.values), want, equal_nan=True)
+        assert fab.stats()["hedges"] == 1
+
+
+class TestCampaign:
+    def test_short_fabric_campaign_green(self):
+        from sketches_tpu import chaos
+
+        verdict = chaos.run_fabric_campaign(40, seed=5)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["outcomes"].get("undetected", 0) == 0
+
+    def test_disarmed_campaign_green(self, monkeypatch):
+        from sketches_tpu import chaos
+
+        monkeypatch.setenv(registry.FABRIC.name, "0")
+        verdict = chaos.run_fabric_campaign(10, seed=5)
+        assert verdict["ok"], verdict["errors"]
+        assert verdict["disarmed"]
+        assert verdict["outcomes"] == {"detected": 10}

@@ -829,6 +829,75 @@ def _detect_window_agg_stale():
     )
 
 
+def _fabric_fleet():
+    from sketches_tpu.fabric import FabricConfig, ServeFabric
+    from sketches_tpu.windows import VirtualClock
+
+    fab = ServeFabric(
+        FabricConfig(n_hosts=4, replication=3, staleness_s=600.0),
+        clock=VirtualClock(0.0),
+    )
+    fab.add_tenant("t", 8, spec=SPEC)
+    rng = np.random.RandomState(32)
+    fab.ingest("t", rng.lognormal(0.0, 0.5, (8, 48)).astype(np.float32))
+    fab.sync("t")
+    return fab
+
+
+def _detect_mesh_partition_heal():
+    """A torn partition heal raises at the seam BEFORE any commit: the
+    host stays partitioned (degraded but consistent, never
+    half-healed), and the clean retry reconciles its replicas."""
+    fab = _fabric_fleet()
+    h = fab.placement("t")[1]  # a replica host
+    fab.partition_host(h)
+    faults.arm(faults.MESH_PARTITION_HEAL, times=1)
+    try:
+        try:
+            fab.heal_partition(h)
+            return False  # the armed tear never surfaced
+        except resilience.InjectedFault:
+            pass
+    finally:
+        faults.disarm()
+    if h in fab.live_hosts():
+        return False  # a torn heal half-committed the un-partition
+    return fab.heal_partition(h) >= 1
+
+
+def _detect_fabric_replica_stale():
+    """Silently corrupted replica state NEVER serves: the serve-time
+    fingerprint-vs-ledger gate refuses it, the read re-homes onto the
+    next verified replica with a bit-identical answer, and the refusal
+    is counted in the health ledger."""
+    import binascii
+
+    fab = _fabric_fleet()
+    direct = np.asarray(fab.quantile("t", [0.5, 0.99]).values)
+    fab.partition_host(fab.placement("t")[0])
+    before = fab.stats()["stale_refusals"]
+    # Pick a plan seed whose first firing flips the high exponent bit:
+    # material on any bin, occupied or empty (a mantissa flip on an
+    # empty bin is provably harmless, which is not this proof).
+    seed = next(
+        s for s in range(256)
+        if ((binascii.crc32(f"{s}:1".encode()) & 0xFFFFFFFF) >> 25) % 3 == 2
+    )
+    faults.arm(faults.FABRIC_REPLICA_STALE, times=1, seed=seed)
+    try:
+        served = fab.quantile("t", [0.5, 0.99])
+    finally:
+        faults.disarm()
+    return (
+        fab.stats()["stale_refusals"] == before + 1
+        and served.role == "replica"
+        and np.array_equal(np.asarray(served.values), direct, equal_nan=True)
+        and resilience.health()["counters"].get(
+            "fabric.replica_stale_refusals", 0
+        ) >= 1
+    )
+
+
 #: Every injectable site maps to a detector proof -- the closure the
 #: satellite task demands: no silently undetectable fault site.
 _SITE_DETECTORS = {
@@ -849,6 +918,8 @@ _SITE_DETECTORS = {
     faults.WINDOW_ROTATE_TORN: _detect_window_rotate_torn,
     faults.WINDOW_STACK_TORN: _detect_window_stack_torn,
     faults.WINDOW_AGG_STALE: _detect_window_agg_stale,
+    faults.MESH_PARTITION_HEAL: _detect_mesh_partition_heal,
+    faults.FABRIC_REPLICA_STALE: _detect_fabric_replica_stale,
 }
 
 
