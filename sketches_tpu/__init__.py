@@ -71,7 +71,7 @@ from sketches_tpu import fabric
 from sketches_tpu.fabric import FabricConfig, ServeFabric
 from sketches_tpu.resilience import FabricUnavailable, ReplicaStale
 
-__version__ = "0.18.0"
+__version__ = "0.19.0"
 
 __all__ = [
     "BaseDDSketch",

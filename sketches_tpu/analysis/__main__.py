@@ -16,6 +16,10 @@ Useful flags::
     --baseline PATH       override the suppression file
     --update-baseline     rewrite the baseline to suppress every current
                           finding (then justify or fix each entry!)
+    --stats               per-rule finding counts + files scanned
+    --budgets PATH        override the static-cost budgets file
+    --update-budgets      re-measure and rewrite the budgets file (then
+                          justify the new ceilings in review!)
 """
 
 from __future__ import annotations
@@ -70,6 +74,22 @@ def main(argv: List[str] | None = None) -> int:
         "--json", dest="json_path", default=None,
         help="write the machine-readable JSON report here",
     )
+    parser.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-rule finding counts and the files-scanned total",
+    )
+    parser.add_argument(
+        "--budgets",
+        default=None,
+        help="static-cost budgets file (default:"
+        " <root>/analysis/budgets.json; jaxpr layer only)",
+    )
+    parser.add_argument(
+        "--update-budgets",
+        action="store_true",
+        help="re-measure every static cost and rewrite the budgets file",
+    )
     args = parser.parse_args(argv)
 
     root = os.path.abspath(args.root or _default_root())
@@ -89,10 +109,33 @@ def main(argv: List[str] | None = None) -> int:
     # The jaxpr audit traces the *imported* package, so it only means
     # something when the linted root IS that package.
     run_jaxpr = not args.no_jaxpr and root == _default_root()
+    budgets_path = args.budgets or os.path.join(
+        root, "analysis", "budgets.json"
+    )
+    if args.update_budgets:
+        if not run_jaxpr:
+            print(
+                "error: --update-budgets needs the jaxpr layer (default"
+                " root, no --no-jaxpr)",
+                file=sys.stderr,
+            )
+            return 2
+        from sketches_tpu.analysis import jaxpr_audit
+
+        doc = jaxpr_audit.measure_budgets()
+        jaxpr_audit.write_budgets(budgets_path, doc)
+        print(
+            f"budgets: wrote {len(doc['entries'])} entry pin(s),"
+            f" {len(doc['ingest_elem_ops_per_value'])} ingest-variant"
+            f" pin(s) to {budgets_path}"
+        )
+        return 0
     if run_jaxpr:
         from sketches_tpu.analysis import jaxpr_audit
 
-        jaxpr_findings, jaxpr_report = jaxpr_audit.audit()
+        jaxpr_findings, jaxpr_report = jaxpr_audit.audit(
+            budgets_path=budgets_path
+        )
         findings.extend(jaxpr_findings)
         report["layers"]["jaxpr"] = True
         report["jaxpr"] = jaxpr_report
@@ -125,6 +168,16 @@ def main(argv: List[str] | None = None) -> int:
 
     for f in active:
         print(f)
+    if args.stats:
+        ctx_files = len(lint_mod.LintContext(root).files)
+        print(f"stats: {ctx_files} file(s) scanned")
+        counts: dict = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        for rule_id in sorted(counts):
+            print(f"stats: {rule_id}: {counts[rule_id]}")
+        if not counts:
+            print("stats: no findings")
     if stale:
         print(
             f"warning: {len(stale)} stale baseline entr"
@@ -134,8 +187,10 @@ def main(argv: List[str] | None = None) -> int:
         )
     n_rules_note = f" ({suppressed} baselined)" if suppressed else ""
     if active:
+        first = active[0]
         print(
-            f"sketchlint: {len(active)} violation(s){n_rules_note}",
+            f"sketchlint: {len(active)} violation(s){n_rules_note};"
+            f" first offender: [{first.rule}] at {first.path}:{first.line}",
             file=sys.stderr,
         )
         return 1

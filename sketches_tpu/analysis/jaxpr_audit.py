@@ -29,6 +29,16 @@ operands at the eligibility caps) and checks it against the declared
 :data:`VMEM_BUDGET_BYTES` -- the "kernels fit VMEM" convention,
 machine-checked (``vmem-budget``).
 
+The **budgets gate** (``jaxpr-budget``) pins the same census in a
+checked-in file, ``analysis/budgets.json``: per-entry element-ops per
+output value, collective-primitive counts, and the VMEM total.  A
+lowering change that exceeds a pin by more than
+:data:`BUDGET_TOLERANCE_PCT` percent (element ops; collectives and VMEM
+are exact ceilings) fails the run, as does an unpinned or stale entry
+-- regressions must be consciously re-pinned (``--update-budgets``),
+never silently absorbed.  See :func:`measure_budgets` /
+:func:`check_budgets`.
+
 Everything returns :class:`~sketches_tpu.analysis.lint.Finding` objects
 (layer ``"jaxpr"``) so the CLI, baseline, and JSON report treat both
 layers uniformly.  jax imports stay inside functions: importing this
@@ -44,11 +54,16 @@ from sketches_tpu.analysis.lint import Finding
 __all__ = [
     "VMEM_BUDGET_BYTES",
     "ELEMENTWISE_PRIMS",
+    "COLLECTIVE_PRIMS",
     "audit",
     "audit_callable",
+    "check_budgets",
     "default_entry_points",
     "elem_ops_per_value",
+    "load_budgets",
+    "measure_budgets",
     "vmem_report",
+    "write_budgets",
 ]
 
 #: Per-core VMEM on the targeted TPU generations (v4/v5e: 16 MiB).  The
@@ -74,6 +89,17 @@ ELEMENTWISE_PRIMS = frozenset(
     select_n convert_element_type clamp is_finite
     exp exp2 log log1p expm1 sqrt rsqrt cbrt logistic tanh erf
     population_count clz bitcast_convert_type
+    """.split()
+)
+
+#: Cross-device communication primitives counted by the budget census.
+#: Every audited entry point is single-device today, so the checked-in
+#: budgets pin these at zero -- a refactor that sneaks a collective into
+#: a serving path fails the static-analysis job, not a TPU bench.
+COLLECTIVE_PRIMS = frozenset(
+    """
+    psum pmax pmin pmean ppermute pshuffle all_gather all_to_all
+    reduce_scatter
     """.split()
 )
 
@@ -174,8 +200,8 @@ def audit_callable(
                     )
                     break
     # One finding per (rule, entry) is enough signal; dedup repeats.
-    seen = set()
-    unique = []
+    seen: set = set()
+    unique: List[Finding] = []
     for f in findings:
         if f.fingerprint not in seen:
             seen.add(f.fingerprint)
@@ -371,18 +397,246 @@ def vmem_report() -> Dict:
     }
 
 
+# ---------------------------------------------------------------------------
+# CI-pinned static cost budgets (analysis/budgets.json)
+# ---------------------------------------------------------------------------
+
+#: Upward drift allowed on elementwise lane-op counts before the gate
+#: fails.  The census is deterministic for a fixed jax version, so the
+#: slack only absorbs tracer-formulation churn across jax upgrades --
+#: a real construction-width regression (the §2-r17 ladder kind) moves
+#: by whole rows, far past 2%.
+BUDGET_TOLERANCE_PCT = 2.0
+
+_BUDGET_PATH = "<budgets:analysis/budgets.json>"
+
+
+def _entry_census(fn: Callable, args: Sequence) -> Optional[Dict]:
+    """Trace ``fn(*args)`` -> {"elem_ops": N, "collectives": {prim: n}}
+    (None when the entry fails to trace -- ``audit_callable`` already
+    reports that as ``jaxpr-trace``)."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception:  # noqa: BLE001 - jaxpr-trace owns the report
+        return None
+    elem_ops = 0
+    collectives: Dict[str, int] = {}
+    for sub in _iter_jaxprs(closed.jaxpr):
+        for eqn in sub.eqns:
+            prim = eqn.primitive.name
+            if prim in COLLECTIVE_PRIMS:
+                collectives[prim] = collectives.get(prim, 0) + 1
+            if prim not in ELEMENTWISE_PRIMS:
+                continue
+            size = 0
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                shape = getattr(aval, "shape", None)
+                if shape is not None:
+                    n = 1
+                    for d in shape:
+                        n *= int(d)
+                    size = max(size, n)
+            elem_ops += size
+    return {"elem_ops": elem_ops, "collectives": collectives}
+
+
+def measure_budgets(
+    entries: Optional[List[Tuple[str, Callable, Sequence]]] = None,
+    ingest_variants: Optional[Sequence[str]] = None,
+) -> Dict:
+    """Measure the full static-cost surface -> a budgets document.
+
+    Three cost families, all derived from traces (no TPU): per-entry
+    elementwise lane-op totals and collective census, the per-variant
+    ingest construction width (:func:`elem_ops_per_value`), and the
+    overlap engine's worst-case VMEM footprint.  ``entries`` and
+    ``ingest_variants`` default to the full audited surface; tests pass
+    small synthetic sets.
+    """
+    from sketches_tpu import kernels
+
+    if entries is None:
+        entries = default_entry_points()
+    if ingest_variants is None:
+        ingest_variants = kernels.INGEST_VARIANTS
+    doc: Dict = {
+        "version": 1,
+        "tolerance_pct": BUDGET_TOLERANCE_PCT,
+        "entries": {},
+        "ingest_elem_ops_per_value": {},
+        "vmem_total_bytes": vmem_report()["total_bytes"],
+    }
+    for name, fn, args in entries:
+        census = _entry_census(fn, args)
+        if census is not None:
+            doc["entries"][name] = census
+    for variant in ingest_variants:
+        doc["ingest_elem_ops_per_value"][variant] = round(
+            elem_ops_per_value(variant), 4
+        )
+    return doc
+
+
+def load_budgets(path: str) -> Optional[Dict]:
+    """The checked-in budgets document (None when absent)."""
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def write_budgets(path: str, doc: Dict) -> None:
+    """Write a budgets document (``--update-budgets``)."""
+    import json
+
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def check_budgets(budgets: Optional[Dict], measured: Dict) -> List[Finding]:
+    """Gate the measured costs against the checked-in budgets.
+
+    Budgets are *ceilings*: an entry may get cheaper silently, but
+    costing more than budget (beyond ``tolerance_pct`` for lane-op
+    counts; exactly for collectives and VMEM), introducing an
+    unbudgeted entry point, or keeping a stale budget row all fail --
+    each failure names ``--update-budgets`` as the (reviewed) way out.
+    """
+    findings: List[Finding] = []
+    if budgets is None:
+        return [
+            Finding(
+                "jaxpr-budget",
+                _BUDGET_PATH,
+                0,
+                "no budgets file is checked in; run `python -m"
+                " sketches_tpu.analysis --update-budgets` and commit"
+                " analysis/budgets.json",
+                layer="jaxpr",
+            )
+        ]
+    tol = 1.0 + float(
+        budgets.get("tolerance_pct", BUDGET_TOLERANCE_PCT)
+    ) / 100.0
+    b_entries = budgets.get("entries", {})
+    m_entries = measured.get("entries", {})
+    for name in sorted(set(m_entries) - set(b_entries)):
+        findings.append(
+            Finding(
+                "jaxpr-budget",
+                _BUDGET_PATH,
+                0,
+                f"entry point {name} has no budget row; every audited"
+                " entry point is cost-pinned (--update-budgets)",
+                layer="jaxpr",
+            )
+        )
+    for name in sorted(set(b_entries) - set(m_entries)):
+        findings.append(
+            Finding(
+                "jaxpr-budget",
+                _BUDGET_PATH,
+                0,
+                f"budget row {name} matches no audited entry point --"
+                " stale pin (--update-budgets)",
+                layer="jaxpr",
+            )
+        )
+    for name in sorted(set(b_entries) & set(m_entries)):
+        b, m = b_entries[name], m_entries[name]
+        if m["elem_ops"] > b.get("elem_ops", 0) * tol:
+            findings.append(
+                Finding(
+                    "jaxpr-budget",
+                    _BUDGET_PATH,
+                    0,
+                    f"{name}: {m['elem_ops']} elementwise lane-ops exceeds"
+                    f" the budgeted {b.get('elem_ops', 0)} -- a static"
+                    " cost regression; fix the width or justify it via"
+                    " --update-budgets in review",
+                    layer="jaxpr",
+                )
+            )
+        b_coll = b.get("collectives", {})
+        for prim, count in sorted(m.get("collectives", {}).items()):
+            if count > b_coll.get(prim, 0):
+                findings.append(
+                    Finding(
+                        "jaxpr-budget",
+                        _BUDGET_PATH,
+                        0,
+                        f"{name}: collective {prim!r} appears {count}x"
+                        f" against a budget of {b_coll.get(prim, 0)} --"
+                        " a new cross-device sync in a serving path",
+                        layer="jaxpr",
+                    )
+                )
+    b_ingest = budgets.get("ingest_elem_ops_per_value", {})
+    for variant, value in sorted(
+        measured.get("ingest_elem_ops_per_value", {}).items()
+    ):
+        if variant not in b_ingest:
+            findings.append(
+                Finding(
+                    "jaxpr-budget",
+                    _BUDGET_PATH,
+                    0,
+                    f"ingest variant {variant!r} has no construction-width"
+                    " budget (--update-budgets)",
+                    layer="jaxpr",
+                )
+            )
+        elif value > b_ingest[variant] * tol:
+            findings.append(
+                Finding(
+                    "jaxpr-budget",
+                    _BUDGET_PATH,
+                    0,
+                    f"ingest variant {variant!r}: {value:g} lane-ops/value"
+                    f" exceeds the budgeted {b_ingest[variant]:g} -- the"
+                    " §2-r17 construction-width regression class",
+                    layer="jaxpr",
+                )
+            )
+    vmem_budget = budgets.get("vmem_total_bytes")
+    vmem_measured = measured.get("vmem_total_bytes", 0)
+    if vmem_budget is not None and vmem_measured > vmem_budget:
+        findings.append(
+            Finding(
+                "jaxpr-budget",
+                _BUDGET_PATH,
+                0,
+                f"overlap-ring VMEM footprint grew to {vmem_measured}"
+                f" bytes against a budgeted {vmem_budget} -- the ring no"
+                " longer fits its pinned envelope",
+                layer="jaxpr",
+            )
+        )
+    return findings
+
+
 def audit(
     entries: Optional[List[Tuple[str, Callable, Sequence]]] = None,
+    budgets_path: Optional[str] = None,
 ) -> Tuple[List[Finding], Dict]:
     """Run the full layer-2 audit -> (findings, machine-readable report).
 
     ``entries`` defaults to :func:`default_entry_points`; tests pass
-    synthetic callables to prove each check fires.
+    synthetic callables to prove each check fires.  With
+    ``budgets_path`` the static-cost census runs too and is gated
+    against the checked-in budgets document (``jaxpr-budget``).
     """
     if entries is None:
         entries = default_entry_points()
     findings: List[Finding] = []
-    report: Dict = {"entries": {}, "vmem": None}
+    report: Dict = {"entries": {}, "vmem": None, "budgets": None}
     for name, fn, args in entries:
         entry_findings = audit_callable(name, fn, args)
         findings.extend(entry_findings)
@@ -404,4 +658,16 @@ def audit(
                 layer="jaxpr",
             )
         )
+    if budgets_path is not None:
+        budgets = load_budgets(budgets_path)
+        measured = measure_budgets(entries)
+        budget_findings = check_budgets(budgets, measured)
+        findings.extend(budget_findings)
+        report["budgets"] = {
+            "path": budgets_path,
+            "checked": budgets is not None,
+            "measured": measured,
+            "findings": [f.to_dict() for f in budget_findings],
+            "ok": not budget_findings,
+        }
     return findings, report

@@ -150,6 +150,26 @@ class LintContext:
                 with open(cand, "r", encoding="utf-8") as f:
                     self.readme = f.read()
                 break
+        # Aux inventories OUTSIDE the package: the closure rules prove
+        # test-time and CI-time inventories against the package, so the
+        # context carries them when the surrounding repo checkout has
+        # them (absent in installed-package scans -- rules then report
+        # the missing inventory rather than silently passing).
+        self.aux_trees: Dict[str, SourceFile] = {}
+        self.aux_texts: Dict[str, str] = {}
+        aux_py = os.path.join(base, "tests", "test_integrity.py")
+        if os.path.exists(aux_py):
+            rel = os.path.relpath(aux_py, base).replace(os.sep, "/")
+            with open(aux_py, "r", encoding="utf-8") as f:
+                self.aux_trees[rel] = SourceFile(rel, f.read())
+        wf_dir = os.path.join(base, ".github", "workflows")
+        if os.path.isdir(wf_dir):
+            for fn in sorted(os.listdir(wf_dir)):
+                if not fn.endswith((".yml", ".yaml")):
+                    continue
+                rel = f".github/workflows/{fn}"
+                with open(os.path.join(wf_dir, fn), "r", encoding="utf-8") as f:
+                    self.aux_texts[rel] = f.read()
 
     # -- path helpers -------------------------------------------------------
     def rel_in_package(self, rel_path: str) -> str:
@@ -291,7 +311,7 @@ def write_baseline(path: str, findings: Sequence[Finding]) -> None:
     expected to either fix the finding or replace the placeholder with a
     real justification in review.
     """
-    seen = {}
+    seen: Dict[str, dict] = {}
     for f in findings:
         seen.setdefault(
             f.fingerprint, {"fingerprint": f.fingerprint, "reason": str(f)}

@@ -33,11 +33,34 @@ host-callback       no ``pure_callback``/``io_callback``/``host_callback``
 telemetry-names     every telemetry metric/span name in the package is a
                     string literal declared in ``telemetry.py``'s
                     ``Metric`` inventory (no stringly-typed drift)
+lock-discipline     attributes accessed under a class's instance lock are
+                    accessed under it *everywhere* (whole-class inference,
+                    ``analysis/concurrency.py``)
+lock-escape         lock-guarded objects never leak raw out of the lock
+                    region (returned or stored onto a foreign object)
+seam-premutation    methods passing a torn ``faults.ATOMIC_SITES`` site
+                    mutate no ``self`` state before the seam
+                    (``analysis/seams.py``)
+seam-commit         the first post-seam ``self`` mutation is a single
+                    reference swap, not an in-place edit
+seam-sites          ``ATOMIC_SITES`` is a subset of ``SITES`` and every
+                    ``*_TORN`` inject site is declared atomic
+site-detector       every ``faults.SITES`` member has a
+                    ``_SITE_DETECTORS`` entry in
+                    ``tests/test_integrity.py`` (and no stale keys)
+metric-doc          every declared ``Metric`` has a backticked README row
+campaign-ci         every chaos ``--campaign`` choice is exercised by a CI
+                    workflow
 ==================  ======================================================
 """
 
+from sketches_tpu.analysis import (  # noqa: F401  (import = register)
+    concurrency,
+    seams,
+)
 from sketches_tpu.analysis.rules import (  # noqa: F401  (import = register)
     callbacks,
+    closure,
     determinism,
     docstrings,
     dtypes,
@@ -49,11 +72,14 @@ from sketches_tpu.analysis.rules import (  # noqa: F401  (import = register)
 
 __all__ = [
     "callbacks",
+    "closure",
+    "concurrency",
     "determinism",
     "docstrings",
     "dtypes",
     "engines",
     "env_registry",
     "raises",
+    "seams",
     "telemetry_names",
 ]

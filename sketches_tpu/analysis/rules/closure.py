@@ -1,0 +1,270 @@
+"""Rules ``site-detector`` / ``metric-doc`` / ``campaign-ci``: test-time
+inventories become lint-time failures.
+
+Three closure properties the repo already asserts *dynamically* --
+``tests/test_integrity.py``'s ``test_every_site_has_a_detector``, the
+telemetry README table, and the chaos campaign matrix -- are promoted
+to ``python -m sketches_tpu.analysis`` failures so a gap fails the
+static-analysis job (seconds) instead of a soak job (minutes), and
+fails it even when the test suite is filtered:
+
+* ``site-detector`` -- every ``faults.SITES`` member appears as a
+  ``faults.<CONST>`` key of ``tests/test_integrity.py``'s
+  ``_SITE_DETECTORS`` table, and every detector key is a declared site
+  (a stale key is a detector probing nothing).
+* ``metric-doc`` -- every ``Metric(...)`` declared in ``telemetry.py``
+  has a README row.  README tokens are backticked; a ``{...}`` suffix
+  is either a label set (``ingest_s{component,engine}`` -> strip) or a
+  brace expansion (``ingest.variant.{stock,packed}`` -> one row per
+  member), and both readings are accepted.
+* ``campaign-ci`` -- every ``chaos --campaign`` choice is exercised by
+  some CI workflow: an explicit ``--campaign <name>`` occurrence, or --
+  for the argparse default only -- any bare ``sketches_tpu.chaos``
+  invocation.
+
+Failure modes: the aux inventories live *outside* the package, so a
+scan of an installed package (no ``tests/``, no ``.github/``) reports
+the missing inventory as a finding rather than silently passing --
+suppress with the usual inline/baseline machinery if such a scan is
+ever intended.  Fixture trees without ``faults.py`` / ``telemetry.py``
+/ ``chaos.py`` skip the corresponding rule (nothing is declared).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from sketches_tpu.analysis.lint import Finding, LintContext, rule
+
+_INTEGRITY_AUX = "tests/test_integrity.py"
+_BACKTICK = re.compile(r"`([^`\s][^`]*)`")
+_EXPANSION = re.compile(r"^(.*)\{([^{}]+)\}(.*)$")
+
+
+def _sites_decl(ctx: LintContext) -> Dict[str, int]:
+    """``faults.SITES`` member constant names -> declaration line."""
+    sf = ctx.file_in_package("faults.py")
+    if sf is None or sf.tree is None:
+        return {}
+    for node in sf.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "SITES"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return {
+                e.id: e.lineno
+                for e in node.value.elts
+                if isinstance(e, ast.Name)
+            }
+    return {}
+
+
+@rule("site-detector")
+def check_site_detectors(ctx: LintContext) -> Iterable[Finding]:
+    sites = _sites_decl(ctx)
+    if not sites:
+        return []
+    faults_sf = ctx.file_in_package("faults.py")
+    assert faults_sf is not None  # _sites_decl parsed it
+    aux = ctx.aux_trees.get(_INTEGRITY_AUX)
+    if aux is None or aux.tree is None:
+        return [
+            Finding(
+                "site-detector",
+                faults_sf.path,
+                min(sites.values()),
+                f"faults.SITES declares {len(sites)} fault sites but no"
+                f" {_INTEGRITY_AUX} detector inventory was found next to"
+                " the package",
+            )
+        ]
+    detectors: Dict[str, int] = {}
+    for node in ast.walk(aux.tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_SITE_DETECTORS"
+            and isinstance(node.value, ast.Dict)
+        ):
+            continue
+        for key in node.value.keys:
+            if (
+                isinstance(key, ast.Attribute)
+                and isinstance(key.value, ast.Name)
+                and key.value.id == "faults"
+            ):
+                detectors[key.attr] = key.lineno
+    out: List[Finding] = []
+    for name, lineno in sorted(sites.items()):
+        if name not in detectors:
+            out.append(
+                Finding(
+                    "site-detector",
+                    faults_sf.path,
+                    lineno,
+                    f"fault site faults.{name} has no _SITE_DETECTORS entry"
+                    f" in {_INTEGRITY_AUX}; every site needs a detector"
+                    " proving its fault is observable",
+                )
+            )
+    for name, lineno in sorted(detectors.items()):
+        if name not in sites:
+            out.append(
+                Finding(
+                    "site-detector",
+                    aux.path,
+                    lineno,
+                    f"_SITE_DETECTORS key faults.{name} is not a member of"
+                    " faults.SITES -- a detector probing an undeclared"
+                    " site",
+                )
+            )
+    return out
+
+
+def _readme_metric_tokens(readme: str) -> Set[str]:
+    """Every backticked README token, with ``{...}`` read both as a
+    label suffix (stripped) and as a brace expansion (each member)."""
+    out: Set[str] = set()
+    for tok in _BACKTICK.findall(readme):
+        tok = tok.strip()
+        out.add(tok)
+        m = _EXPANSION.match(tok)
+        if m is None:
+            continue
+        head, members, tail = m.groups()
+        out.add((head + tail).rstrip("."))
+        out.add(head.rstrip(".{") + tail)
+        for member in members.split(","):
+            out.add(f"{head}{member.strip()}{tail}")
+    return out
+
+
+@rule("metric-doc")
+def check_metric_docs(ctx: LintContext) -> Iterable[Finding]:
+    from sketches_tpu.analysis.rules.telemetry_names import _declared_metrics
+
+    declared = _declared_metrics(ctx)
+    if not declared:
+        return []
+    telemetry_sf = ctx.file_in_package("telemetry.py")
+    assert telemetry_sf is not None  # _declared_metrics parsed it
+    if ctx.readme is None:
+        return [
+            Finding(
+                "metric-doc",
+                telemetry_sf.path,
+                min(declared.values()),
+                f"telemetry.py declares {len(declared)} metrics but no"
+                " README.md was found to document them",
+            )
+        ]
+    documented = _readme_metric_tokens(ctx.readme)
+    out: List[Finding] = []
+    for name, lineno in sorted(declared.items()):
+        if name not in documented:
+            out.append(
+                Finding(
+                    "metric-doc",
+                    telemetry_sf.path,
+                    lineno,
+                    f"declared metric {name!r} has no README row; an"
+                    " operator cannot discover what the process measures",
+                )
+            )
+    return out
+
+
+def _campaign_choices(ctx: LintContext) -> Dict[str, int]:
+    """``--campaign`` argparse choices in ``chaos.py`` -> line, plus the
+    default under the pseudo-key ``__default__:<name>``."""
+    sf = ctx.file_in_package("chaos.py")
+    if sf is None or sf.tree is None:
+        return {}
+    out: Dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and node.args[0].value == "--campaign"
+        ):
+            continue
+        default: Optional[str] = None
+        for kw in node.keywords:
+            if kw.arg == "choices" and isinstance(
+                kw.value, (ast.Tuple, ast.List)
+            ):
+                for e in kw.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                        e.value, str
+                    ):
+                        out[e.value] = e.lineno
+            if kw.arg == "default" and isinstance(kw.value, ast.Constant):
+                default = kw.value.value
+        if isinstance(default, str):
+            out.setdefault(default, node.lineno)
+            out["__default__:" + default] = node.lineno
+    return out
+
+
+@rule("campaign-ci")
+def check_campaign_ci(ctx: LintContext) -> Iterable[Finding]:
+    choices = _campaign_choices(ctx)
+    default = next(
+        (k.split(":", 1)[1] for k in choices if k.startswith("__default__:")),
+        None,
+    )
+    names = {
+        k: v
+        for k, v in choices.items()
+        if k and not k.startswith("__default__:")
+    }
+    if not names:
+        return []
+    chaos_sf = ctx.file_in_package("chaos.py")
+    assert chaos_sf is not None  # _campaign_choices parsed it
+    if not ctx.aux_texts:
+        return [
+            Finding(
+                "campaign-ci",
+                chaos_sf.path,
+                min(names.values()),
+                f"chaos declares {len(names)} campaigns but no CI workflow"
+                " files were found next to the package",
+            )
+        ]
+    ci_blob = "\n".join(ctx.aux_texts.values())
+    # A default-campaign run is a chaos invocation with NO explicit
+    # --campaign on the same line.
+    bare_chaos = any(
+        re.search(r"-m\s+sketches_tpu\.chaos\b", line)
+        and "--campaign" not in line
+        for line in ci_blob.splitlines()
+    )
+    out: List[Finding] = []
+    for name, lineno in sorted(names.items()):
+        explicit = re.search(
+            rf"--campaign[=\s]+{re.escape(name)}\b", ci_blob
+        )
+        if explicit is None and not (name == default and bare_chaos):
+            out.append(
+                Finding(
+                    "campaign-ci",
+                    chaos_sf.path,
+                    lineno,
+                    f"chaos campaign {name!r} is never run by a CI"
+                    " workflow (no '--campaign" f" {name}' in"
+                    " .github/workflows); an unexercised campaign is"
+                    " dead coverage",
+                )
+            )
+    return out

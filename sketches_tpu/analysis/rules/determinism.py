@@ -64,7 +64,7 @@ def check(ctx: LintContext) -> Iterable[Finding]:
         clock_allowed = ctx.rel_in_package(sf.path) in _CLOCK_ALLOWED_FILES
         # Pre-pass: seeded-generator constructions are the sanctioned RNG
         # pattern.  Their func nodes are exempted by identity below.
-        seeded_funcs = set()
+        seeded_funcs: set = set()
         for node in ast.walk(sf.tree):
             if (
                 isinstance(node, ast.Call)

@@ -86,7 +86,7 @@ def check(ctx: LintContext) -> Iterable[Finding]:
     # Index every top-level def/class in the tree (the export may live in
     # any module; __init__ re-exports it).
     defs: Dict[str, Tuple[str, ast.AST]] = {}
-    module_names = set()
+    module_names: set = set()
     for sf in ctx.iter_files():
         if sf.tree is None:
             continue

@@ -330,20 +330,23 @@ class ServeFabric:
 
     @property
     def n_hosts(self) -> int:
-        return len(self._hosts)
+        with self._lock:
+            return len(self._hosts)
 
     def placement(self, name: str) -> Tuple[int, ...]:
         """The tenant's CURRENT copy set (primary first).  Reflects
         failovers and handoffs, unlike the pure :func:`placement`
         function it started from."""
-        return tuple(self._meta(name).hosts)
+        with self._lock:
+            return tuple(self._meta(name).hosts)
 
     def live_hosts(self) -> Tuple[int, ...]:
         """Hosts that are alive AND reachable (not partitioned)."""
-        return tuple(
-            i for i, h in enumerate(self._hosts)
-            if h.alive and not h.partitioned
-        )
+        with self._lock:
+            return tuple(
+                i for i, h in enumerate(self._hosts)
+                if h.alive and not h.partitioned
+            )
 
     def _meta(self, name: str) -> _TenantMeta:
         m = self._tenants.get(name)
@@ -1076,10 +1079,10 @@ class ServeFabric:
         ``SketchServer.reshard_tenant`` (mesh-sharded primaries only;
         fabric tenants are dense today, so this raises ``SpecError``
         until distributed tenants replicate)."""
-        meta = self._meta(name)
-        return self._hosts[meta.hosts[0]].server.reshard_tenant(
-            name, *args, **kwargs
-        )
+        with self._lock:
+            meta = self._meta(name)
+            server = self._hosts[meta.hosts[0]].server
+        return server.reshard_tenant(name, *args, **kwargs)
 
     # -- introspection ----------------------------------------------------
 
@@ -1117,6 +1120,7 @@ class ServeFabric:
 
     def host_server(self, host_id: int) -> SketchServer:
         """The virtual host's underlying server (drills and tests)."""
-        if not (0 <= host_id < self.n_hosts):
-            raise SketchValueError(f"no host {host_id}")
-        return self._hosts[host_id].server
+        with self._lock:
+            if not (0 <= host_id < self.n_hosts):
+                raise SketchValueError(f"no host {host_id}")
+            return self._hosts[host_id].server

@@ -186,6 +186,20 @@ SITES = (
     FABRIC_REPLICA_STALE,
 )
 
+#: Torn-write seams: sites whose enclosing method promises the
+#: atomic-commit contract (zero ``self`` mutations before the inject,
+#: commit by reference swap after it).  The contract is proven
+#: structurally by ``analysis/seams.py`` and probed dynamically by the
+#: chaos campaigns; a new torn site MUST be listed here or sketchlint's
+#: ``seam-sites`` rule fails the build.
+ATOMIC_SITES = (
+    CHECKPOINT_WRITE,
+    RESHARD_TORN,
+    WINDOW_ROTATE_TORN,
+    WINDOW_STACK_TORN,
+    MESH_PARTITION_HEAL,
+)
+
 #: Fast-path guard: seams check this module flag before calling
 #: :func:`inject`, so a fully disarmed harness costs one bool test.
 _ACTIVE = False

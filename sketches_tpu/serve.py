@@ -412,8 +412,8 @@ class SketchServer:
         """
         from sketches_tpu.parallel import DistributedDDSketch
 
-        t = self._tenant(name)
         with self._lock:
+            t = self._tenant(name)
             if not isinstance(t.facade, DistributedDDSketch):
                 raise SpecError(
                     f"tenant {name!r} is not mesh-sharded; only"
@@ -439,7 +439,8 @@ class SketchServer:
 
     def tenant(self, name: str):
         """The named tenant's facade (raises ``SpecError`` if unknown)."""
-        return self._tenant(name).facade
+        with self._lock:
+            return self._tenant(name).facade
 
     def _tenant(self, name: str) -> _Tenant:
         t = self._tenants.get(name)
@@ -457,8 +458,8 @@ class SketchServer:
         recomputes.  Ingest failures degrade/raise exactly as the
         facade's engine ladder does.
         """
-        t = self._tenant(name)
         with self._lock:
+            t = self._tenant(name)
             t.facade.add(values, weights)
             t.version += 1
             t.fp_cache = None
@@ -469,8 +470,8 @@ class SketchServer:
         """Fold another ``BatchedDDSketch`` into tenant ``name`` (write
         path; same invalidation discipline as :meth:`ingest`).  Unequal
         specs raise ``UnequalSketchParametersError``."""
-        t = self._tenant(name)
         with self._lock:
+            t = self._tenant(name)
             t.facade.merge(other)
             t.version += 1
             t.fp_cache = None
@@ -483,8 +484,8 @@ class SketchServer:
         tenant is unknown).  Without this, stale entries are still
         caught -- the hit-time live-fingerprint re-verification
         quarantines them -- but at hit-time cost."""
-        t = self._tenant(name)
         with self._lock:
+            t = self._tenant(name)
             t.version += 1
             t.fp_cache = None
 
@@ -731,8 +732,9 @@ class SketchServer:
         failed; unknown tiers raise ``SpecError``)."""
         if tier not in QUERY_LADDER:
             raise SpecError(f"unknown engine tier {tier!r}")
-        b = self._breakers.get(tier)
-        return b.state if b is not None else "closed"
+        with self._lock:
+            b = self._breakers.get(tier)
+            return b.state if b is not None else "closed"
 
     def _breaker_failure(self, tier: str) -> None:
         if tier not in _BREAKABLE_TIERS:
@@ -1050,19 +1052,21 @@ class SketchServer:
         deadline budgets raise :class:`DeadlineExceeded`; late answers
         are returned but counted; unknown tenants raise ``SpecError``.
         """
-        t = self._tenant(name)
-        if not self._is_windowed(t):
-            if window is not None:
-                raise SpecError(
-                    f"tenant {name!r} is not time-windowed: register it"
-                    " with add_tenant(..., window=...) to serve"
-                    " window-scoped quantiles"
-                )
-            return self.query(name, quantiles, deadline_s)
         qs = tuple(sorted(float(q) for q in quantiles))
-        if not qs:
-            raise SketchValueError("a request needs at least one quantile")
         with self._lock:
+            t = self._tenant(name)
+            if not self._is_windowed(t):
+                if window is not None:
+                    raise SpecError(
+                        f"tenant {name!r} is not time-windowed: register it"
+                        " with add_tenant(..., window=...) to serve"
+                        " window-scoped quantiles"
+                    )
+                return self.query(name, quantiles, deadline_s)
+            if not qs:
+                raise SketchValueError(
+                    "a request needs at least one quantile"
+                )
             self._stats["requests"] += 1
             now = self._clock()
             _trc = tracing.new_trace() if tracing._ACTIVE else None

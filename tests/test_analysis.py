@@ -598,11 +598,16 @@ class TestLiveTree:
         assert active == [], "\n".join(str(f) for f in active)
 
     def test_zero_jaxpr_audit_findings(self):
-        findings, report = jaxpr_audit.audit()
+        budgets_path = os.path.join(PKG_ROOT, "analysis", "budgets.json")
+        findings, report = jaxpr_audit.audit(budgets_path=budgets_path)
         assert findings == [], "\n".join(str(f) for f in findings)
         assert report["vmem"]["ok"]
         assert len(report["entries"]) >= 9
         assert all(e["ok"] for e in report["entries"].values())
+        # The checked-in static-cost budgets hold against a fresh
+        # measurement (the CI budget gate, pinned here too).
+        assert report["budgets"]["checked"], "analysis/budgets.json missing"
+        assert report["budgets"]["ok"]
 
     def test_package_version_bumped(self):
         # Tuple compare, not string compare: "0.10.0" < "0.7.0" as text.
@@ -652,3 +657,583 @@ class TestCli:
             "--no-jaxpr", "--root", root, "--baseline", str(bl)
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_stats_flag_reports_counts_and_first_offender(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "def f():\n    raise ValueError('injected')\n",
+        })
+        proc = self._run("--no-jaxpr", "--stats", "--root", root)
+        assert proc.returncode == 1
+        assert "stats:" in proc.stdout
+        assert "file(s) scanned" in proc.stdout
+        assert "stats: taxonomy-raise: 1" in proc.stdout
+        assert "first offender: [taxonomy-raise]" in proc.stderr
+
+    def test_stats_flag_on_clean_tree(self):
+        proc = self._run("--no-jaxpr", "--stats")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "stats: no findings" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Lock-discipline pass (analysis/concurrency.py)
+# ---------------------------------------------------------------------------
+
+_LOCK_HEADER = (
+    "import threading\n"
+    "\n"
+    "class Server:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.RLock()\n"
+    "        self._table = {}\n"
+    "        self._count = 0\n"
+    "\n"
+    "    def put(self, k, v):\n"
+    "        with self._lock:\n"
+    "            self._table[k] = v\n"
+    "            self._count += 1\n"
+)
+
+
+class TestLockDiscipline:
+    def test_flags_unlocked_read_of_guarded_attr(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def racy_get(self, k):\n"
+                "        return self._table.get(k)\n"
+            ),
+        })
+        found = run_lint(root, only=["lock-discipline"])
+        assert len(found) == 1
+        assert "racy_get" in found[0].message
+        assert "_table" in found[0].message
+
+    def test_flags_unlocked_write(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def racy_reset(self):\n"
+                "        self._count = 0\n"
+            ),
+        })
+        found = run_lint(root, only=["lock-discipline"])
+        assert len(found) == 1
+        assert "written" in found[0].message
+
+    def test_clean_twin_passes(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def safe_get(self, k):\n"
+                "        with self._lock:\n"
+                "            return self._table.get(k)\n"
+            ),
+        })
+        assert run_lint(root, only=["lock-discipline"]) == []
+
+    def test_helper_reached_only_under_lock_is_clean(self, tmp_path):
+        # The fixpoint closure: _drain is never syntactically locked but
+        # every call site holds the lock, so its accesses are locked.
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def flush(self):\n"
+                "        with self._lock:\n"
+                "            self._drain()\n"
+                "\n"
+                "    def _drain(self):\n"
+                "        self._count = 0\n"
+            ),
+        })
+        assert run_lint(root, only=["lock-discipline"]) == []
+
+    def test_locked_suffix_called_unlocked_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def kick(self):\n"
+                "        self._drain_locked()\n"
+                "\n"
+                "    def _drain_locked(self):\n"
+                "        self._count = 0\n"
+            ),
+        })
+        found = run_lint(root, only=["lock-discipline"])
+        assert len(found) == 1
+        assert "_drain_locked" in found[0].message
+
+    def test_lock_free_class_is_out_of_scope(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": (
+                "class Plain:\n"
+                "    def __init__(self):\n"
+                "        self._table = {}\n"
+                "    def get(self, k):\n"
+                "        return self._table.get(k)\n"
+            ),
+        })
+        assert run_lint(root, only=["lock-discipline", "lock-escape"]) == []
+
+    def test_escape_via_return_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def peek(self):\n"
+                "        with self._lock:\n"
+                "            return self._table\n"
+            ),
+        })
+        found = run_lint(root, only=["lock-escape"])
+        assert len(found) == 1
+        assert "returned" in found[0].message
+
+    def test_escape_via_foreign_store_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def leak(self, sink):\n"
+                "        with self._lock:\n"
+                "            sink.ref = self._table\n"
+            ),
+        })
+        found = run_lint(root, only=["lock-escape"])
+        assert len(found) == 1
+        assert "stored" in found[0].message
+
+    def test_escape_clean_twin_copy_passes(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": _LOCK_HEADER + (
+                "\n"
+                "    def snapshot(self):\n"
+                "        with self._lock:\n"
+                "            return dict(self._table)\n"
+            ),
+        })
+        assert run_lint(root, only=["lock-escape"]) == []
+
+
+# ---------------------------------------------------------------------------
+# Atomic-commit seams pass (analysis/seams.py)
+# ---------------------------------------------------------------------------
+
+_FAULTS_FIXTURE = (
+    'CHECKPOINT_WRITE = "checkpoint.write"\n'
+    'WINDOW_ROTATE_TORN = "window.rotate_torn"\n'
+    "SITES = (CHECKPOINT_WRITE, WINDOW_ROTATE_TORN)\n"
+    "ATOMIC_SITES = (CHECKPOINT_WRITE, WINDOW_ROTATE_TORN)\n"
+    "def inject(site, payload=None):\n"
+    "    return payload\n"
+)
+
+
+class TestSeamContracts:
+    def test_premutation_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": _FAULTS_FIXTURE,
+            "mod.py": (
+                "from . import faults\n"
+                "\n"
+                "class Box:\n"
+                "    def __init__(self):\n"
+                "        self._state = []\n"
+                "        self._n = 0\n"
+                "\n"
+                "    def rotate(self):\n"
+                "        self._n += 1\n"
+                "        plan = [1, 2]\n"
+                "        plan = faults.inject(\n"
+                "            faults.WINDOW_ROTATE_TORN, payload=plan)\n"
+                "        self._state = plan\n"
+            ),
+        })
+        found = run_lint(root, only=["seam-premutation"])
+        assert len(found) == 1
+        assert "self._n" in found[0].message
+
+    def test_premutation_through_alias_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": _FAULTS_FIXTURE,
+            "mod.py": (
+                "from . import faults\n"
+                "\n"
+                "class Box:\n"
+                "    def __init__(self):\n"
+                "        self._state = []\n"
+                "\n"
+                "    def heal(self):\n"
+                "        h = self._state\n"
+                "        h.append(1)\n"
+                "        out = faults.inject(\n"
+                "            faults.CHECKPOINT_WRITE, payload=0)\n"
+                "        self._state = [out]\n"
+            ),
+        })
+        found = run_lint(root, only=["seam-premutation"])
+        assert len(found) == 1
+        assert "h.append" in found[0].message
+
+    def test_inplace_commit_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": _FAULTS_FIXTURE,
+            "mod.py": (
+                "from . import faults\n"
+                "\n"
+                "class Box:\n"
+                "    def __init__(self):\n"
+                "        self._state = []\n"
+                "\n"
+                "    def rotate(self):\n"
+                "        plan = [1, 2]\n"
+                "        plan = faults.inject(\n"
+                "            faults.WINDOW_ROTATE_TORN, payload=plan)\n"
+                "        self._state.clear()\n"
+                "        self._state.extend(plan)\n"
+            ),
+        })
+        found = run_lint(root, only=["seam-commit"])
+        assert len(found) == 1
+        assert "clear" in found[0].message
+
+    def test_clean_twin_plan_inject_swap_passes(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": _FAULTS_FIXTURE,
+            "mod.py": (
+                "from . import faults\n"
+                "\n"
+                "class Box:\n"
+                "    def __init__(self):\n"
+                "        self._state = []\n"
+                "        self._n = 0\n"
+                "\n"
+                "    def rotate(self):\n"
+                "        plan = [x for x in self._state] + [1]\n"
+                "        plan = faults.inject(\n"
+                "            faults.WINDOW_ROTATE_TORN, payload=plan)\n"
+                "        self._state = plan\n"
+                "        self._n += 1\n"
+            ),
+        })
+        assert run_lint(
+            root, only=["seam-premutation", "seam-commit"]
+        ) == []
+
+    def test_undeclared_torn_inject_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": (
+                'CHECKPOINT_WRITE = "checkpoint.write"\n'
+                'OTHER_TORN = "other.torn"\n'
+                "SITES = (CHECKPOINT_WRITE, OTHER_TORN)\n"
+                "ATOMIC_SITES = (CHECKPOINT_WRITE,)\n"
+                "def inject(site, payload=None):\n"
+                "    return payload\n"
+            ),
+            "mod.py": (
+                "from . import faults\n"
+                "def f():\n"
+                "    return faults.inject(faults.OTHER_TORN, payload=1)\n"
+            ),
+        })
+        found = run_lint(root, only=["seam-sites"])
+        assert len(found) == 1
+        assert "OTHER_TORN" in found[0].message
+
+    def test_atomic_site_outside_sites_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "faults.py": (
+                'CHECKPOINT_WRITE = "checkpoint.write"\n'
+                'GHOST = "ghost.site"\n'
+                "SITES = (CHECKPOINT_WRITE,)\n"
+                "ATOMIC_SITES = (CHECKPOINT_WRITE, GHOST)\n"
+            ),
+        })
+        found = run_lint(root, only=["seam-sites"])
+        assert len(found) == 1
+        assert "GHOST" in found[0].message
+
+    def test_no_faults_module_is_inert(self, tmp_path):
+        root = make_pkg(tmp_path, {
+            "mod.py": "def f():\n    return 1\n",
+        })
+        assert run_lint(
+            root, only=["seam-premutation", "seam-commit", "seam-sites"]
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Closure rules (analysis/rules/closure.py)
+# ---------------------------------------------------------------------------
+
+
+def _write_aux(tmp_path, rel, content):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(content)
+
+
+class TestSiteDetectorClosure:
+    def test_missing_detector_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"faults.py": _FAULTS_FIXTURE})
+        _write_aux(tmp_path, "tests/test_integrity.py", (
+            "from fixturepkg import faults\n"
+            "def _d():\n    return True\n"
+            "_SITE_DETECTORS = {\n"
+            "    faults.CHECKPOINT_WRITE: _d,\n"
+            "}\n"
+        ))
+        found = run_lint(root, only=["site-detector"])
+        assert len(found) == 1
+        assert "WINDOW_ROTATE_TORN" in found[0].message
+
+    def test_stale_detector_key_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"faults.py": _FAULTS_FIXTURE})
+        _write_aux(tmp_path, "tests/test_integrity.py", (
+            "from fixturepkg import faults\n"
+            "def _d():\n    return True\n"
+            "_SITE_DETECTORS = {\n"
+            "    faults.CHECKPOINT_WRITE: _d,\n"
+            "    faults.WINDOW_ROTATE_TORN: _d,\n"
+            "    faults.REMOVED_SITE: _d,\n"
+            "}\n"
+        ))
+        found = run_lint(root, only=["site-detector"])
+        assert len(found) == 1
+        assert "REMOVED_SITE" in found[0].message
+
+    def test_closed_inventory_passes(self, tmp_path):
+        root = make_pkg(tmp_path, {"faults.py": _FAULTS_FIXTURE})
+        _write_aux(tmp_path, "tests/test_integrity.py", (
+            "from fixturepkg import faults\n"
+            "def _d():\n    return True\n"
+            "_SITE_DETECTORS = {\n"
+            "    faults.CHECKPOINT_WRITE: _d,\n"
+            "    faults.WINDOW_ROTATE_TORN: _d,\n"
+            "}\n"
+        ))
+        assert run_lint(root, only=["site-detector"]) == []
+
+    def test_missing_inventory_file_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"faults.py": _FAULTS_FIXTURE})
+        found = run_lint(root, only=["site-detector"])
+        assert len(found) == 1
+        assert "no tests/test_integrity.py" in found[0].message
+
+
+_TELEMETRY_FIXTURE = (
+    "class Metric:\n"
+    "    def __init__(self, name, doc=''):\n"
+    "        self.name = name\n"
+    'METRICS = (Metric("req_s"), Metric("cache.hits"),'
+    ' Metric("cache.misses"))\n'
+)
+
+
+class TestMetricDocClosure:
+    def test_undocumented_metric_flags(self, tmp_path):
+        root = make_pkg(
+            tmp_path,
+            {"telemetry.py": _TELEMETRY_FIXTURE},
+            readme="# pkg\n\n| `req_s{tenant}` | request latency |\n",
+        )
+        found = run_lint(root, only=["metric-doc"])
+        assert {"cache.hits" in f.message or "cache.misses" in f.message
+                for f in found} == {True}
+        assert len(found) == 2
+
+    def test_label_suffix_and_brace_expansion_both_document(self, tmp_path):
+        root = make_pkg(
+            tmp_path,
+            {"telemetry.py": _TELEMETRY_FIXTURE},
+            readme=(
+                "# pkg\n\n"
+                "| `req_s{tenant,engine}` | request latency |\n"
+                "| `cache.{hits,misses}` | cache outcomes |\n"
+            ),
+        )
+        assert run_lint(root, only=["metric-doc"]) == []
+
+    def test_no_readme_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"telemetry.py": _TELEMETRY_FIXTURE})
+        found = run_lint(root, only=["metric-doc"])
+        assert len(found) == 1
+        assert "no README.md" in found[0].message
+
+
+_CHAOS_FIXTURE = (
+    "import argparse\n"
+    "def main():\n"
+    "    p = argparse.ArgumentParser()\n"
+    "    p.add_argument(\n"
+    '        "--campaign",\n'
+    '        choices=("core", "serve", "windowed"),\n'
+    '        default="core",\n'
+    "    )\n"
+)
+
+
+class TestCampaignCiClosure:
+    def test_unexercised_campaign_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"chaos.py": _CHAOS_FIXTURE})
+        _write_aux(tmp_path, ".github/workflows/ci.yml", (
+            "jobs:\n"
+            "  chaos:\n"
+            "    run: python -m sketches_tpu.chaos --steps 100\n"
+            "  serve:\n"
+            "    run: python -m sketches_tpu.chaos --campaign serve\n"
+        ))
+        found = run_lint(root, only=["campaign-ci"])
+        assert len(found) == 1
+        assert "'windowed'" in found[0].message
+
+    def test_full_matrix_passes(self, tmp_path):
+        root = make_pkg(tmp_path, {"chaos.py": _CHAOS_FIXTURE})
+        _write_aux(tmp_path, ".github/workflows/ci.yml", (
+            "jobs:\n"
+            "  chaos:\n"
+            "    run: python -m sketches_tpu.chaos --steps 100\n"
+            "  serve:\n"
+            "    run: python -m sketches_tpu.chaos --campaign serve\n"
+            "  windowed:\n"
+            "    run: python -m sketches_tpu.chaos --campaign windowed\n"
+        ))
+        assert run_lint(root, only=["campaign-ci"]) == []
+
+    def test_default_needs_some_chaos_invocation(self, tmp_path):
+        root = make_pkg(tmp_path, {"chaos.py": _CHAOS_FIXTURE})
+        _write_aux(tmp_path, ".github/workflows/ci.yml", (
+            "jobs:\n"
+            "  serve:\n"
+            "    run: python -m sketches_tpu.chaos --campaign serve\n"
+            "  windowed:\n"
+            "    run: python -m sketches_tpu.chaos --campaign windowed\n"
+        ))
+        found = run_lint(root, only=["campaign-ci"])
+        assert len(found) == 1
+        assert "'core'" in found[0].message
+
+    def test_missing_workflows_flags(self, tmp_path):
+        root = make_pkg(tmp_path, {"chaos.py": _CHAOS_FIXTURE})
+        found = run_lint(root, only=["campaign-ci"])
+        assert len(found) == 1
+        assert "no CI workflow" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# Static-cost budgets (analysis/budgets.json + jaxpr_audit gate)
+# ---------------------------------------------------------------------------
+
+
+class TestBudgets:
+    def _measured(self):
+        return {
+            "version": 1,
+            "tolerance_pct": 2.0,
+            "entries": {
+                "fix.f": {"elem_ops": 1000, "collectives": {}},
+            },
+            "ingest_elem_ops_per_value": {"stock": 100.0},
+            "vmem_total_bytes": 4096,
+        }
+
+    def test_missing_budgets_file_is_a_finding(self):
+        found = jaxpr_audit.check_budgets(None, self._measured())
+        assert len(found) == 1
+        assert "no budgets file" in found[0].message
+
+    def test_identical_budgets_pass(self):
+        m = self._measured()
+        assert jaxpr_audit.check_budgets(m, m) == []
+
+    def test_elem_ops_regression_flags(self):
+        m = self._measured()
+        b = json.loads(json.dumps(m))
+        b["entries"]["fix.f"]["elem_ops"] = 500
+        found = jaxpr_audit.check_budgets(b, m)
+        assert len(found) == 1
+        assert "regression" in found[0].message
+
+    def test_within_tolerance_passes(self):
+        m = self._measured()
+        b = json.loads(json.dumps(m))
+        b["entries"]["fix.f"]["elem_ops"] = 990  # 1% drift < 2% tol
+        assert jaxpr_audit.check_budgets(b, m) == []
+
+    def test_new_collective_flags(self):
+        m = self._measured()
+        m["entries"]["fix.f"]["collectives"] = {"psum": 1}
+        b = self._measured()
+        found = jaxpr_audit.check_budgets(b, m)
+        assert len(found) == 1
+        assert "psum" in found[0].message
+
+    def test_unbudgeted_and_stale_entries_flag(self):
+        m = self._measured()
+        b = json.loads(json.dumps(m))
+        b["entries"]["gone.entry"] = {"elem_ops": 1, "collectives": {}}
+        m["entries"]["new.entry"] = {"elem_ops": 1, "collectives": {}}
+        rules = sorted(
+            f.message for f in jaxpr_audit.check_budgets(b, m)
+        )
+        assert len(rules) == 2
+        assert any("new.entry" in msg for msg in rules)
+        assert any("gone.entry" in msg for msg in rules)
+
+    def test_ingest_width_regression_flags(self):
+        m = self._measured()
+        b = json.loads(json.dumps(m))
+        b["ingest_elem_ops_per_value"]["stock"] = 90.0
+        found = jaxpr_audit.check_budgets(b, m)
+        assert len(found) == 1
+        assert "stock" in found[0].message
+
+    def test_vmem_growth_flags(self):
+        m = self._measured()
+        b = json.loads(json.dumps(m))
+        b["vmem_total_bytes"] = 2048
+        found = jaxpr_audit.check_budgets(b, m)
+        assert len(found) == 1
+        assert "VMEM" in found[0].message
+
+    def test_entry_census_counts_elementwise_ops(self):
+        import jax.numpy as jnp
+
+        census = jaxpr_audit._entry_census(
+            lambda x: x * 2 + 1, (jnp.ones((4, 8), jnp.float32),)
+        )
+        assert census is not None
+        # mul + add over a 32-element operand = 64 lane-ops.
+        assert census["elem_ops"] == 64
+        assert census["collectives"] == {}
+
+    def test_update_then_gate_round_trip(self, tmp_path):
+        # The --update-budgets contract: a freshly measured document
+        # always passes its own gate.
+        import jax.numpy as jnp
+
+        entries = [
+            ("fix.f", lambda x: (x * 2).sum(), (jnp.ones(8, jnp.float32),)),
+        ]
+        doc = jaxpr_audit.measure_budgets(entries, ingest_variants=())
+        path = str(tmp_path / "budgets.json")
+        jaxpr_audit.write_budgets(path, doc)
+        loaded = jaxpr_audit.load_budgets(path)
+        assert loaded == doc
+        remeasured = jaxpr_audit.measure_budgets(entries, ingest_variants=())
+        assert jaxpr_audit.check_budgets(loaded, remeasured) == []
+
+    def test_doctored_budget_fails_the_gate(self, tmp_path):
+        import jax.numpy as jnp
+
+        entries = [
+            ("fix.f", lambda x: (x * 2).sum(), (jnp.ones(8, jnp.float32),)),
+        ]
+        doc = jaxpr_audit.measure_budgets(entries, ingest_variants=())
+        doc["entries"]["fix.f"]["elem_ops"] //= 2
+        path = str(tmp_path / "budgets.json")
+        jaxpr_audit.write_budgets(path, doc)
+        found = jaxpr_audit.check_budgets(
+            jaxpr_audit.load_budgets(path),
+            jaxpr_audit.measure_budgets(entries, ingest_variants=()),
+        )
+        assert found, "doctored budget must fail the gate"
+        assert all(f.rule == "jaxpr-budget" for f in found)
